@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Flagship benchmark: ResNet-50 training throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline anchor (BASELINE.md): the binding target is >=0.8x reference CUDA
+per-device throughput; with the reference unmeasurable this session, the
+denominator is the public MLPerf-era MXNet ResNet-50 fp16 V100 anchor
+(~1400 img/s/device, SURVEY.md §6).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_IMG_S = 1400.0  # MXNet-on-V100 fp16 order-of-magnitude anchor
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    backend = jax.default_backend()
+    batch = int(os.environ.get("BENCH_BATCH", "64" if backend != "cpu" else "8"))
+    size = int(os.environ.get("BENCH_IMG", "224" if backend != "cpu" else "32"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16" if backend != "cpu" else "float32")
+    steps = int(os.environ.get("BENCH_STEPS", "20" if backend != "cpu" else "3"))
+
+    net = vision.resnet50_v1() if backend != "cpu" else vision.resnet18_v1(classes=10)
+    net.initialize(init=mx.initializer.Xavier())
+    if dtype != "float32":
+        net.cast(dtype)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    step = parallel.SPMDTrainStep(net, loss_fn, "sgd", {"momentum": 0.9, "wd": 1e-4},
+                                  mesh=None)
+    x = mx.nd.array(np.random.rand(batch, 3, size, size).astype(np.float32))
+    if dtype != "float32":
+        x = x.astype(dtype)
+    y = mx.nd.array(np.random.randint(0, 10, (batch,)).astype(np.float32))
+
+    # warmup (compile)
+    for _ in range(3):
+        loss = step(x, y, lr=0.05, sync=False)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y, lr=0.05, sync=False)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * steps / dt
+    print(json.dumps({
+        "metric": f"resnet50_v1_train_{dtype}_bs{batch}_{backend}",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

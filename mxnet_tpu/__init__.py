@@ -1,0 +1,53 @@
+"""mxnet_tpu — a TPU-native framework with MXNet 1.x's capability surface.
+
+Built from scratch on JAX/XLA/pjit (see SURVEY.md for the blueprint and
+the reference layer map it re-implements TPU-first). Import as::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu())
+"""
+
+__version__ = "0.1.0"
+
+from . import base  # noqa: F401
+from .base import MXNetError  # noqa: F401
+from .context import (  # noqa: F401
+    Context,
+    cpu,
+    cpu_pinned,
+    gpu,
+    tpu,
+    num_gpus,
+    num_tpus,
+    current_context,
+)
+from . import ndarray  # noqa: F401
+from . import ndarray as nd  # noqa: F401
+from . import autograd  # noqa: F401
+from . import random  # noqa: F401
+from .ndarray import NDArray  # noqa: F401
+
+# subsystems imported lazily to keep import fast
+from . import initializer  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import Optimizer  # noqa: F401
+from . import lr_scheduler  # noqa: F401
+from . import metric  # noqa: F401
+from . import gluon  # noqa: F401
+from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401
+from . import io  # noqa: F401
+from . import recordio  # noqa: F401
+from . import image  # noqa: F401
+from . import callback  # noqa: F401
+from . import profiler  # noqa: F401
+from . import runtime  # noqa: F401
+from . import test_utils  # noqa: F401
+from . import symbol  # noqa: F401
+from . import symbol as sym  # noqa: F401
+from .symbol import Symbol  # noqa: F401
+from . import module  # noqa: F401
+from . import monitor  # noqa: F401
+from . import visualization  # noqa: F401
+from . import parallel  # noqa: F401
+from .util import is_np_array, set_np, reset_np  # noqa: F401

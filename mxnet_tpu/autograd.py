@@ -1,0 +1,313 @@
+"""Tape-based autograd over an eager JAX front-end.
+
+Reference: ``python/mxnet/autograd.py`` + ``src/imperative/imperative.cc``
+(symbols ``Imperative::RecordOp`` / ``Imperative::Backward`` / ``AGInfo``).
+
+TPU-native design (SURVEY.md §7.2): while ``record()`` is active, every op
+dispatched through :mod:`mxnet_tpu.ops.dispatch` is computed via ``jax.vjp``
+and a tape node holding the VJP closure is linked into a graph hanging off
+the output NDArrays. ``backward()`` walks that graph in reverse topological
+order, calling the stored VJPs and accumulating cotangents into the
+``.grad`` buffers of arrays that called ``attach_grad()`` — exact MXNet
+semantics including ``grad_req='add'``, intermediate ``attach_grad``, and
+``retain_graph``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_STATE = _State()
+
+
+def is_recording() -> bool:
+    return _STATE.recording
+
+
+def is_training() -> bool:
+    return _STATE.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _STATE.recording = _STATE.recording, is_record
+    return prev
+
+
+def set_training(train_mode: bool) -> bool:
+    prev, _STATE.training = _STATE.training, train_mode
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_record = is_record
+        self._enter_train = train_mode
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_STATE.recording, _STATE.training)
+        if self._enter_record is not None:
+            _STATE.recording = self._enter_record
+        if self._enter_train is not None:
+            _STATE.training = self._enter_train
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.recording, _STATE.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope in which executed ops are recorded on the tape."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# --------------------------------------------------------------------------
+# Tape graph
+# --------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: holds the VJP closure and graph edges."""
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_arrays", "out_cts", "name", "_order")
+
+    def __init__(self, vjp_fn, inputs, n_outputs, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of NDArray handles (tracked inputs)
+        self.n_outputs = n_outputs
+        self.out_cts = None  # filled during backward
+        self.name = name
+        self._order = -1
+
+
+def _node_of(arr):
+    info = getattr(arr, "_ag", None)
+    return info[0] if info is not None else None
+
+
+def is_tracked(arr) -> bool:
+    """Does gradient flow through this array? (has grad buffer or on tape)"""
+    return getattr(arr, "_ag", None) is not None or getattr(arr, "_grad", None) is not None
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: ``autograd.py:mark_variables`` — associate grad buffers."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad if req != "null" else None
+        var._grad_req = req
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+
+def _toposort(root_nodes):
+    order = []
+    seen = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            child = _node_of(inp)
+            if child is not None and id(child) not in seen:
+                stack.append((child, False))
+    return order  # children before parents
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False, train_mode: bool = True):
+    """Run backward from ``heads`` (NDArrays), accumulating into ``.grad``.
+
+    Reference: ``MXAutogradBackwardEx`` / ``Imperative::Backward``.
+    """
+    from .ndarray.ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # Seed cotangents keyed by array identity.
+    cts = {}
+
+    def _add_ct(arr, ct):
+        key = id(arr)
+        if key in cts:
+            cts[key] = (arr, cts[key][1] + ct)
+        else:
+            cts[key] = (arr, ct)
+
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        node = _node_of(h)
+        if node is None and h._grad is None:
+            raise MXNetError(
+                "cannot differentiate a head that is not on the tape; "
+                "run inside autograd.record() and/or attach_grad()"
+            )
+        seed = hg.data if hg is not None else jnp.ones(h.shape, h.data.dtype)
+        _add_ct(h, seed)
+        if node is not None:
+            roots.append(node)
+
+    order = _toposort(roots)
+
+    # reverse topological: parents (later ops) first
+    for node in reversed(order):
+        # gather output cotangents for this node
+        outs = node.out_arrays
+        any_ct = False
+        out_cts = []
+        for o in outs:
+            ent = cts.get(id(o))
+            if ent is None:
+                out_cts.append(jnp.zeros(o.shape, o.data.dtype))
+            else:
+                out_cts.append(ent[1])
+                any_ct = True
+        if not any_ct or node.vjp_fn is None:
+            continue
+        ct_in = tuple(out_cts) if node.n_outputs > 1 else out_cts[0]
+        in_cts = node.vjp_fn(ct_in)
+        for arr, g in zip(node.inputs, in_cts):
+            if g is None:
+                continue
+            _add_ct(arr, g)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    # write into attached grad buffers
+    for _, (arr, ct) in cts.items():
+        if arr._grad is not None:
+            req = getattr(arr, "_grad_req", "write")
+            if req == "add":
+                arr._grad._set_data(arr._grad.data + ct)
+            elif req != "null":
+                arr._grad._set_data(jnp.asarray(ct, arr._grad.data.dtype))
+
+    if not retain_graph:
+        for node in order:
+            for o in node.out_arrays:
+                o._ag = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Reference: ``autograd.py:grad`` — return grads w.r.t. ``variables``.
+
+    ``create_graph`` (higher-order tape) is not yet supported; use
+    ``jax.grad`` composition via hybridized blocks for higher-order needs.
+    """
+    from .ndarray.ndarray import NDArray, array as _mk
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True not supported yet")
+    single = isinstance(variables, NDArray)
+    if single:
+        variables = [variables]
+    saved = [(v._grad, getattr(v, "_grad_req", "write")) for v in variables]
+    for v in variables:
+        v._grad = _mk(jnp.zeros(v.shape, v.data.dtype), ctx=v.ctx)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph))
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return out[0] if single else out
+
+
+def get_symbol(x):  # reference parity stub (symbolic tape export)
+    raise NotImplementedError("autograd.get_symbol is not supported")
+
+
+class Function:
+    """Customizable differentiable function (reference: ``autograd.Function``).
+
+    Subclass and implement ``forward`` and ``backward``; both receive/return
+    NDArrays. The forward runs with autograd paused; the backward is linked
+    into the tape as a single node.
+    """
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return getattr(self, "_saved", ())
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, array as _mk
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = isinstance(outputs, NDArray)
+        outs = [outputs] if single else list(outputs)
+        if is_recording() and any(is_tracked(i) for i in inputs if isinstance(i, NDArray)):
+            tracked = [i for i in inputs if isinstance(i, NDArray)]
+            func = self
+
+            def vjp_fn(out_ct):
+                cts = (out_ct,) if single else tuple(out_ct)
+                with pause():
+                    gs = func.backward(*[_mk(c) for c in cts])
+                if isinstance(gs, NDArray):
+                    gs = [gs]
+                # map grads (given for every input) onto tracked inputs
+                grads_all = list(gs)
+                out = []
+                for i in inputs:
+                    if isinstance(i, NDArray):
+                        g = grads_all.pop(0) if grads_all else None
+                        out.append(None if g is None else g.data)
+                return out
+
+            node = TapeNode(vjp_fn, tracked, len(outs), name=type(self).__name__)
+            node.out_arrays = outs
+            for k, o in enumerate(outs):
+                o._ag = (node, k)
+        return outputs

@@ -1,0 +1,53 @@
+"""Base utilities: error types, env helpers, shape helpers.
+
+TPU-native re-imagining of the reference's ``python/mxnet/base.py``
+(symbol: ``check_call``/``MXNetError``) — there is no C ABI to check
+calls against; errors are plain Python exceptions raised eagerly or,
+for async dispatch, surfaced at sync points (see ``mxnet_tpu.ndarray``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (reference: ``base.py:MXNetError``)."""
+
+
+def getenv(name: str, default=None, *, dtype=str):
+    """Read an ``MXTPU_*`` env var (reference analog: ``dmlc::GetEnv``)."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    if dtype is bool:
+        return v not in ("0", "false", "False", "")
+    return dtype(v)
+
+
+_INT_TYPES = (int,)
+try:  # numpy integers count as ints everywhere shapes appear
+    import numpy as _np
+
+    _INT_TYPES = (int, _np.integer)
+except ImportError:  # pragma: no cover
+    pass
+
+
+def is_int(x) -> bool:
+    return isinstance(x, _INT_TYPES) and not isinstance(x, bool)
+
+
+def check_shape(shape) -> tuple:
+    """Canonicalize a user-supplied shape to a tuple of ints."""
+    if is_int(shape):
+        return (int(shape),)
+    return tuple(int(d) for d in shape)
+
+
+class classproperty:  # noqa: N801 - decorator-style name
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

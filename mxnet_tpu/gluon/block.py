@@ -1,0 +1,611 @@
+"""Gluon Block / HybridBlock / CachedOp.
+
+Reference: ``python/mxnet/gluon/block.py`` (symbols ``Block``, ``HybridBlock``,
+``_build_cache``, ``_call_cached_op``) + ``src/imperative/cached_op.cc``.
+
+TPU-native CachedOp (SURVEY.md §3.2 — "the exact seam the TPU build
+replaces"): instead of tracing ``hybrid_forward`` with nnvm symbol proxies
+and replaying per-op engine pushes, we *functionalize the imperative
+frontend*: the block's Python forward runs once under ``jax.jit`` tracing
+with its parameter handles temporarily bound to tracers. Every imperative
+op inside lands in one jaxpr; XLA compiles the whole forward into a single
+fused executable. Parameter mutations inside the forward (BatchNorm moving
+stats) are detected at trace time and threaded out as extra outputs, then
+written back into the real parameter buffers after each compiled call —
+state threading, the idiomatic JAX treatment of MXNet's in-kernel aux-state
+mutation. Under ``autograd.record()`` the whole cached call becomes ONE
+tape node via ``jax.vjp`` over the traced function, so backward is also a
+single fused executable.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .parameter import DeferredInitializationError, Parameter, ParameterDict
+
+
+class _BlockScope:
+    """Name scoping for automatic prefixes (reference: ``_BlockScope``)."""
+
+    _state = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def current():
+        return getattr(_BlockScope._state, "scope", None)
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = _BlockScope.current()
+        if current is None:
+            if prefix is None:
+                import mxnet_tpu.name as _name  # lazy; simple global counter
+
+                prefix = _name.next_prefix(hint)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = _BlockScope.current()
+        _BlockScope._state.scope = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return False
+        _BlockScope._state.scope = self._old_scope
+        return False
+
+
+class Block:
+    """Base model-building block (reference: ``gluon.Block``)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(
+            prefix, params, self._alias()
+        )
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            f"  ({key}): {_indent(repr(block), 2)}"
+            for key, block in self._children.items()
+        )
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        existing = getattr(self, name, None) if name in getattr(self, "__dict__", {}) else None
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            if hasattr(self, "_reg_params"):
+                self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def collect_params(self, select=None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update(
+                {k: v for k, v in self.params.items() if pattern.match(k)}
+            )
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from ..ndarray import ndarray as nd
+
+        nd.save(filename, {k: v._data[next(iter(v._data))] for k, v in params.items()})
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import ndarray as nd
+
+        loaded = nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        # legacy full-prefix format fallback
+        if loaded and (not params or (next(iter(loaded)) not in params
+                                      and next(iter(loaded)) in self.collect_params())):
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source)
+            return
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError(
+                        f"Parameter {name} is missing in file {filename}"
+                    )
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"Parameter {name} loaded from file {filename} is "
+                        "not present in the Block"
+                    )
+                continue
+            params[name]._load_init(loaded[name], ctx, cast_dtype=cast_dtype,
+                                    dtype_source=dtype_source)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + k: v for k, v in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save(self, prefix):
+        self.save_parameters(prefix + "-model.params")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        summary = []
+
+        def walk(block, depth):
+            n_params = 0
+            for p in block.params.values():
+                if p.shape and all(s > 0 for s in p.shape):
+                    n = 1
+                    for s in p.shape:
+                        n *= s
+                    n_params += n
+            summary.append(("  " * depth + block.__class__.__name__, n_params))
+            for c in block._children.values():
+                walk(c, depth + 1)
+
+        walk(self, 0)
+        lines = ["-" * 50, f"{'Layer':<38}{'Params':>12}", "=" * 50]
+        total = 0
+        for name, n in summary:
+            lines.append(f"{name:<38}{n:>12}")
+            total += n
+        lines += ["=" * 50, f"Total params: {total}", "-" * 50]
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+
+class _HookHandle:
+    def __init__(self, hooks, hook):
+        self._hooks, self._hook = hooks, hook
+
+    def detach(self):
+        if self._hook in self._hooks:
+            self._hooks.remove(self._hook)
+
+
+def _indent(s, num):
+    lines = s.split("\n")
+    return ("\n" + " " * num).join(lines)
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock + CachedOp
+# ---------------------------------------------------------------------------
+
+_TRACE_STATE = threading.local()  # .active = True while inside a CachedOp trace
+
+
+def _in_cached_trace():
+    return getattr(_TRACE_STATE, "active", False)
+
+
+class HybridBlock(Block):
+    """Block that can be hybridized: traced once, compiled by XLA, replayed.
+
+    Subclasses implement ``hybrid_forward(F, x, *args, **params)`` exactly as
+    in the reference; ``F`` is the ``mx.nd`` namespace (symbolic proxies are
+    unnecessary — tracing happens at the JAX level).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc, static_shape=static_shape)
+        self._cached_graph = None
+        # children are also marked; nested caches are naturally bypassed
+        # inside a parent trace via _in_cached_trace()
+        Block.hybridize(self, active)
+
+    def infer_shape(self, *args):
+        """Set shapes of this block's deferred params from input shapes.
+
+        Built-in layers override this; custom blocks with deferred-init
+        params must too (reference does it via symbolic shape inference).
+        """
+        raise MXNetError(
+            f"{self.__class__.__name__} has deferred-initialization parameters "
+            "but does not implement infer_shape(); specify in_units/in_channels "
+            "or override infer_shape()."
+        )
+
+    # -- eager path ------------------------------------------------------
+    def _resolve_params(self, args):
+        ctx = None
+        for a in args:
+            if isinstance(a, NDArray):
+                ctx = a.ctx
+                break
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            try:
+                kwargs[name] = p.data(ctx)
+            except DeferredInitializationError:
+                self._deferred_infer(args)
+                kwargs[name] = p.data(ctx)
+        return kwargs
+
+    def _deferred_infer(self, args):
+        self.infer_shape(*[a for a in args if isinstance(a, NDArray)])
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def _eager_forward(self, *args):
+        from ..ndarray import op as F
+
+        params = self._resolve_params(args)
+        return self.hybrid_forward(F, *args, **params)
+
+    def forward(self, *args):
+        if self._active and not _in_cached_trace():
+            return self._call_cached(*args)
+        return self._eager_forward(*args)
+
+    # -- cached (hybridized) path ---------------------------------------
+    def _call_cached(self, *args):
+        if self._cached_graph is None:
+            self._cached_graph = _CachedGraph(self)
+        return self._cached_graph(args)
+
+
+class _CachedGraph:
+    """The CachedOp: one compiled XLA executable per input signature.
+
+    Reference: ``src/imperative/cached_op.cc`` (``CachedOp::Forward``).
+    """
+
+    def __init__(self, block):
+        self.block = block
+        self._cache = {}
+        self._params = None  # stable handle list, fixed order
+
+    def _param_handles(self, ctx):
+        params = sorted(self.block.collect_params().items())
+        handles, diff_mask = [], []
+        for name, p in params:
+            h = p.data(ctx)
+            handles.append(h)
+            diff_mask.append(p.grad_req != "null")
+        return handles, diff_mask
+
+    def __call__(self, args):
+        arrays = [a for a in args if isinstance(a, NDArray)]
+        if not arrays or any(isinstance(a, (list, tuple)) for a in args):
+            # non-flat inputs (e.g. RNN state lists): run eagerly
+            return self.block._eager_forward(*args)
+        ctx = arrays[0].ctx
+
+        # first call may need deferred init: run eagerly once
+        try:
+            handles, diff_mask = self._param_handles(ctx)
+        except DeferredInitializationError:
+            return self.block._eager_forward(*args)
+
+        recording = autograd.is_recording()
+        training = autograd.is_training()
+        inputs_tracked = recording and any(autograd.is_tracked(a) for a in arrays)
+        key = (
+            tuple((a.shape, str(a.dtype)) for a in arrays),
+            training,
+            recording,
+            inputs_tracked,
+        )
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(args, arrays, handles, diff_mask, ctx, training,
+                                recording, inputs_tracked)
+            self._cache[key] = entry
+        return entry(args, arrays, handles, ctx)
+
+    def _build(self, args, arrays, handles, diff_mask, ctx, training, recording,
+               inputs_tracked):
+        block = self.block
+        mutated_idx: list = []
+
+        def pure_fn(param_raws, input_raws, key):
+            _TRACE_STATE.active = True
+            _random.push_trace_key(key)
+            saved = [h._data_ for h in handles]
+            saved_ver = [h._version for h in handles]
+            try:
+                for h, raw in zip(handles, param_raws):
+                    h._data_ = raw
+                    h._version += 1
+                it = iter(input_raws)
+                new_args = [
+                    NDArray(next(it), ctx=ctx) if isinstance(a, NDArray) else a
+                    for a in args
+                ]
+                with autograd._RecordingStateScope(False, training):
+                    outs = block._eager_forward(*new_args)
+                single = isinstance(outs, NDArray)
+                out_list = [outs] if single else list(outs)
+                out_raws = [o.data for o in out_list]
+                mutated_idx.clear()
+                mut_raws = []
+                for i, (h, raw) in enumerate(zip(handles, param_raws)):
+                    if h._data_ is not raw:
+                        mutated_idx.append(i)
+                        mut_raws.append(h._data_)
+                return out_raws, mut_raws, single
+            finally:
+                for h, s, v in zip(handles, saved, saved_ver):
+                    h._data_ = s
+                    h._version = v
+                _random.pop_trace_key()
+                _TRACE_STATE.active = False
+
+        single_box = [False]
+        diff_param_pos = [i for i, d in enumerate(diff_mask) if d]
+
+        def assemble(diff_params, nondiff_params):
+            param_raws = [None] * len(handles)
+            di, ni = iter(diff_params), iter(nondiff_params)
+            for i in range(len(handles)):
+                param_raws[i] = next(di) if diff_mask[i] else next(ni)
+            return param_raws
+
+        @jax.jit
+        def fwd_compiled(diff_params, nondiff_params, input_raws, key):
+            out_raws, mut_raws, single = pure_fn(
+                assemble(diff_params, nondiff_params), input_raws, key
+            )
+            single_box[0] = single
+            return out_raws, mut_raws
+
+        if not recording:
+
+            def runner(call_args, call_arrays, call_handles, call_ctx):
+                key = _random._next_key()
+                dp = [call_handles[i].data for i in diff_param_pos]
+                ndp = [call_handles[i].data for i in range(len(call_handles))
+                       if not diff_mask[i]]
+                out_raws, mut_raws = fwd_compiled(
+                    dp, ndp, [a.data for a in call_arrays], key
+                )
+                for i, raw in zip(mutated_idx, mut_raws):
+                    call_handles[i]._set_data(raw)
+                outs = [NDArray(r, ctx=call_ctx) for r in out_raws]
+                return outs[0] if single_box[0] else outs
+
+            return runner
+
+        # Recording path: forward runs the plain compiled executable NOW;
+        # backward is a separately-jitted VJP (residuals rematerialized
+        # inside — one extra fwd inside bwd; the fully-fused train step in
+        # gluon.Trainer avoids even that).
+        bwd_box = [None]
+
+        def get_bwd():
+            if bwd_box[0] is None:
+
+                @jax.jit
+                def bwd_compiled(diff_params, nondiff_params, input_raws, key,
+                                 out_ct, mut_ct):
+                    if inputs_tracked:
+                        def f(dp, ir):
+                            o, m, _ = pure_fn(assemble(dp, nondiff_params), ir, key)
+                            return o, m
+
+                        _, vjp_fn = jax.vjp(f, diff_params, input_raws)
+                        dp_ct, ir_ct = vjp_fn((out_ct, mut_ct))
+                        return list(dp_ct) + list(ir_ct)
+
+                    def f(dp):
+                        o, m, _ = pure_fn(assemble(dp, nondiff_params), input_raws, key)
+                        return o, m
+
+                    _, vjp_fn = jax.vjp(f, diff_params)
+                    (dp_ct,) = vjp_fn((out_ct, mut_ct))
+                    return list(dp_ct)
+
+                bwd_box[0] = bwd_compiled
+            return bwd_box[0]
+
+        def runner(call_args, call_arrays, call_handles, call_ctx):
+            key = _random._next_key()
+            dp = [call_handles[i].data for i in diff_param_pos]
+            ndp = [call_handles[i].data for i in range(len(call_handles))
+                   if not diff_mask[i]]
+            input_raws = [a.data for a in call_arrays]
+            out_raws, mut_raws = fwd_compiled(dp, ndp, input_raws, key)
+            for i, raw in zip(mutated_idx, mut_raws):
+                call_handles[i]._set_data(raw)
+            outs = [NDArray(r, ctx=call_ctx) for r in out_raws]
+
+            tape_inputs = [call_handles[i] for i in diff_param_pos]
+            if inputs_tracked:
+                tape_inputs = tape_inputs + list(call_arrays)
+            mut_zero = [jnp.zeros_like(m) for m in mut_raws]
+
+            def node_vjp(out_ct):
+                cts = list(out_ct) if isinstance(out_ct, (tuple, list)) else [out_ct]
+                return get_bwd()(dp, ndp, input_raws, key, cts, mut_zero)
+
+            node = autograd.TapeNode(node_vjp, tape_inputs, len(outs),
+                                     name=f"CachedOp[{block_name(block)}]")
+            node.out_arrays = outs
+            for k, o in enumerate(outs):
+                o._ag = (node, k)
+            return outs[0] if single_box[0] else outs
+
+        return runner
+
+
+def block_name(b):
+    return getattr(b, "_name", b.__class__.__name__)
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a saved symbolic graph as a block (reference: ``SymbolBlock``)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        from ..symbol.symbol import Symbol
+
+        self._outputs = outputs if isinstance(outputs, Symbol) else outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        arg_names = set(self._outputs.list_arguments())
+        input_names = {i.name for i in self._inputs}
+        for name in self._outputs.list_arguments():
+            if name not in input_names:
+                self.params.get(name, allow_deferred_init=True)
+        for name in self._outputs.list_auxiliary_states():
+            self.params.get(name, allow_deferred_init=True, grad_req="null")
+        if params is not None:
+            for name, value in params.items():
+                clean = name.replace("arg:", "").replace("aux:", "")
+                if clean in self.params:
+                    self.params[clean]._load_init(value)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import symbol as sym_mod
+        from ..ndarray import ndarray as nd
+
+        symbol = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [sym_mod.var(n) for n in input_names]
+        params = nd.load(param_file) if param_file else None
+        ret = SymbolBlock(symbol, inputs, params)
+        if param_file and ctx is not None:
+            ret.collect_params().reset_ctx(ctx)
+        return ret
+
+    def forward(self, *args):
+        from ..symbol.executor import eval_symbol
+
+        arg_dict = {}
+        for inp, a in zip(self._inputs, args):
+            arg_dict[inp.name] = a
+        for name, p in self.params.items():
+            arg_dict[name] = p.data(args[0].ctx if args else None)
+        res = eval_symbol(self._outputs, arg_dict)
+        return res[0] if len(res) == 1 else res

@@ -1,0 +1,1 @@
+"""``mx.gluon.contrib`` (reference: ``python/mxnet/gluon/contrib/``)."""

@@ -1,0 +1,17 @@
+"""``mx.gluon.data`` (reference: ``python/mxnet/gluon/data/``)."""
+
+from .dataset import (  # noqa: F401
+    Dataset,
+    SimpleDataset,
+    ArrayDataset,
+    RecordFileDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler,
+    SequentialSampler,
+    RandomSampler,
+    BatchSampler,
+    IntervalSampler,
+)
+from .dataloader import DataLoader  # noqa: F401
+from . import vision  # noqa: F401

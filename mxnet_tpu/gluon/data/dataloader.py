@@ -1,0 +1,192 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``,
+symbols ``DataLoader``/``_MultiWorkerIter``).
+
+TPU-native: workers are ``multiprocessing`` processes that produce *host*
+numpy batches (batchify happens in the worker, like the reference); the
+main process uploads each batch to device once. The reference's
+CPUSharedStorage IPC is replaced by pickled numpy buffers — the device
+upload (PCIe->HBM) is the same single hop.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import pickle
+
+import numpy as _np
+
+from ...context import cpu
+from ...ndarray.ndarray import NDArray, array as _array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.stack([d.data for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    data = _np.asarray(data)
+    return _array(data, dtype=data.dtype if data.dtype != _np.float64 else _np.float32)
+
+
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: returns numpy (host) buffers."""
+    if isinstance(data[0], NDArray):
+        return _np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(list(i)) for i in data]
+    return _np.asarray(data)
+
+
+def _as_in_context(data, ctx):
+    if isinstance(data, _np.ndarray):
+        return _array(data, ctx=ctx,
+                      dtype=_np.float32 if data.dtype == _np.float64 else None)
+    if isinstance(data, NDArray):
+        return data.as_in_context(ctx)
+    if isinstance(data, (list, tuple)):
+        return [_as_in_context(d, ctx) for d in data]
+    return data
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(samples, batchify_fn, dataset=None):
+    global _worker_dataset
+    ds = dataset if dataset is not None else _worker_dataset
+    batch = batchify_fn([ds[i] for i in samples])
+    return pickle.dumps(batch, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+class _MultiWorkerIter:
+    def __init__(self, worker_pool, batchify_fn, batch_sampler,
+                 pin_memory=False, worker_fn=_worker_fn, prefetch=0,
+                 dataset=None, data_loader=None):
+        self._worker_pool = worker_pool
+        self._batchify_fn = batchify_fn
+        self._batch_sampler = batch_sampler
+        self._data_buffer = {}
+        self._rcvd_idx = 0
+        self._sent_idx = 0
+        self._iter = iter(self._batch_sampler)
+        self._worker_fn = worker_fn
+        self._pin_memory = pin_memory
+        self._dataset = dataset
+        for _ in range(prefetch):
+            self._push_next()
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _push_next(self):
+        r = next(self._iter, None)
+        if r is None:
+            return
+        async_ret = self._worker_pool.apply_async(
+            self._worker_fn, (r, self._batchify_fn, self._dataset)
+        )
+        self._data_buffer[self._sent_idx] = async_ret
+        self._sent_idx += 1
+
+    def __next__(self):
+        self._push_next()
+        if self._rcvd_idx == self._sent_idx:
+            assert not self._data_buffer, "data buffer should be empty at this moment"
+            raise StopIteration
+        ret = self._data_buffer.pop(self._rcvd_idx)
+        batch = pickle.loads(ret.get())
+        batch = _as_in_context(batch, cpu())
+        self._rcvd_idx += 1
+        return batch
+
+    def next(self):
+        return self.__next__()
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """Loads data from a Dataset and returns mini-batches."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is"
+                )
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is"
+            )
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._worker_pool = None
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.pool import ThreadPool
+
+                self._worker_pool = ThreadPool(self._num_workers,
+                                               initializer=_worker_initializer,
+                                               initargs=(self._dataset,))
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._worker_pool = ctx.Pool(
+                    self._num_workers, initializer=_worker_initializer,
+                    initargs=(self._dataset,))
+        if batchify_fn is None:
+            self._batchify_fn = (default_mp_batchify_fn if self._num_workers > 0
+                                 else default_batchify_fn)
+        else:
+            self._batchify_fn = batchify_fn
+
+    def __iter__(self):
+        if self._num_workers == 0:
+
+            def same_process_iter():
+                for batch in self._batch_sampler:
+                    ret = self._batchify_fn([self._dataset[i] for i in batch])
+                    yield ret
+
+            return same_process_iter()
+        return _MultiWorkerIter(
+            self._worker_pool, self._batchify_fn, self._batch_sampler,
+            pin_memory=self._pin_memory, prefetch=self._prefetch,
+            dataset=self._dataset if self._thread_pool else None)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._worker_pool is not None:
+            self._worker_pool.terminate()

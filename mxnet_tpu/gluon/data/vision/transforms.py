@@ -1,0 +1,247 @@
+"""Vision transforms (reference: ``gluon/data/vision/transforms.py``).
+
+Transforms operate on HWC uint8/float NDArrays on the host path; heavy
+per-batch math (normalize, cast) fuses into the device step under
+hybridize like any other op.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ....ndarray.ndarray import NDArray, array as _array
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential
+
+
+class Compose(HybridSequential):
+    """Sequentially compose transforms (reference: ``transforms.Compose``)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return F.cast(x, dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ``ToTensor``)."""
+
+    def hybrid_forward(self, F, x):
+        if x.ndim == 3:
+            out = F.transpose(x, axes=(2, 0, 1))
+        else:
+            out = F.transpose(x, axes=(0, 3, 1, 2))
+        return F.cast(out, dtype="float32") / 255.0
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def hybrid_forward(self, F, x):
+        mean = _np.asarray(self._mean, dtype="float32")
+        std = _np.asarray(self._std, dtype="float32")
+        if mean.ndim == 1:
+            shape = (-1,) + (1,) * (x.ndim - 1 - (0 if x.ndim == 3 else 1))
+            mean = mean.reshape(shape if x.ndim == 3 else (1,) + shape[0:])
+            std = std.reshape(mean.shape)
+        return (x - _array(mean, ctx=x.ctx)) / _array(std, ctx=x.ctx)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image import imresize
+
+        if isinstance(self._size, int):
+            if self._keep:
+                h, w = x.shape[0], x.shape[1]
+                if w < h:
+                    nw, nh = self._size, int(h * self._size / w)
+                else:
+                    nw, nh = int(w * self._size / h), self._size
+            else:
+                nw = nh = self._size
+        else:
+            nw, nh = self._size
+        return imresize(x, nw, nh, interp=self._interpolation)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image import center_crop
+
+        return center_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image import random_size_crop
+
+        return random_size_crop(x, self._size, self._scale, self._ratio,
+                                self._interpolation)[0]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        from ....image import random_crop
+
+        if self._pad:
+            arr = x.asnumpy()
+            p = self._pad
+            arr = _np.pad(arr, ((p, p), (p, p), (0, 0)))
+            x = _array(arr, dtype=str(x.dtype))
+        return random_crop(x, self._size, self._interpolation)[0]
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() < self._p:
+            return x.flip(axis=1 if x.ndim == 3 else 2)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if _pyrandom.random() < self._p:
+            return x.flip(axis=0 if x.ndim == 3 else 1)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._delta = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
+        return x.astype("float32") * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._delta = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
+        xf = x.astype("float32")
+        gray = xf.mean()
+        return xf * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._delta = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
+        xf = x.astype("float32")
+        coef = _array(_np.array([[[0.299, 0.587, 0.114]]], dtype="float32"))
+        gray = (xf * coef).sum(axis=2, keepdims=True)
+        return xf * alpha + gray * (1 - alpha)
+
+
+class RandomHue(Block):
+    def __init__(self, hue):
+        super().__init__()
+        self._delta = hue
+
+    def forward(self, x):
+        # approximate hue rotation in YIQ space (reference uses the same trick)
+        alpha = _pyrandom.uniform(-self._delta, self._delta)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]])
+        t_rgb = _np.linalg.inv(t_yiq)
+        m = t_rgb.dot(bt).dot(t_yiq).T.astype("float32")
+        xf = x.astype("float32")
+        return NDArray(xf.data @ _np.asarray(m), ctx=x.ctx)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+        if hue:
+            self._transforms.append(RandomHue(hue))
+
+    def forward(self, x):
+        ts = list(self._transforms)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise (reference: ``RandomLighting``)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], dtype="float32")
+    _eigvec = _np.array(
+        [[-0.5675, 0.7192, 0.4009],
+         [-0.5808, -0.0045, -0.814],
+         [-0.5836, -0.6948, 0.4203]], dtype="float32")
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = _np.random.normal(0, self._alpha, size=(3,)).astype("float32")
+        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
+        return x.astype("float32") + _array(rgb.reshape((1, 1, 3)))

@@ -1,0 +1,40 @@
+"""``mx.gluon.model_zoo.vision`` (reference: ``model_zoo/vision/``).
+
+``get_model(name)`` registry; pretrained download is unavailable in this
+environment (zero egress) — load local ``.params`` instead.
+"""
+
+from ....base import MXNetError
+from .resnet import (  # noqa: F401
+    get_resnet,
+    resnet18_v1, resnet34_v1, resnet50_v1, resnet101_v1, resnet152_v1,
+    resnet18_v2, resnet34_v2, resnet50_v2, resnet101_v2, resnet152_v2,
+    ResNetV1, ResNetV2,
+    BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
+)
+
+_models = {
+    "resnet18_v1": resnet18_v1,
+    "resnet34_v1": resnet34_v1,
+    "resnet50_v1": resnet50_v1,
+    "resnet101_v1": resnet101_v1,
+    "resnet152_v1": resnet152_v1,
+    "resnet18_v2": resnet18_v2,
+    "resnet34_v2": resnet34_v2,
+    "resnet50_v2": resnet50_v2,
+    "resnet101_v2": resnet101_v2,
+    "resnet152_v2": resnet152_v2,
+}
+
+
+def register_model(name, fn):
+    _models[name] = fn
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"Model {name} is not supported. Available: {sorted(_models)}"
+        )
+    return _models[name](**kwargs)

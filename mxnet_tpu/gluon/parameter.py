@@ -1,0 +1,399 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference: ``python/mxnet/gluon/parameter.py`` (symbols ``Parameter``,
+``ParameterDict``, ``defer_init``). Same deferred-init and multi-device
+replication semantics; buffers are NDArray handles that stay *stable* across
+updates (the tape and Trainer key off handle identity).
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from .. import initializer
+from ..base import MXNetError
+from ..context import Context, current_context, cpu
+from ..ndarray.ndarray import NDArray
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known."""
+
+
+def _shape_known(shape):
+    return shape is not None and all(s and s > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._data = None  # {Context: NDArray}
+        self._grad = None
+        self._deferred_init = None  # (init, ctx_list, default_init)
+        self._ctx_list = None
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self._shape}, dtype={self.dtype})"
+
+    # -- shape ------------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape) or any(
+            s not in (0, u) and u != 0 for s, u in zip(self._shape, new_shape)
+        ):
+            # allow filling unknown (0) dims only
+            merged = []
+            for s, u in zip(self._shape, new_shape):
+                if s in (0, None):
+                    merged.append(u)
+                elif u in (0, None) or s == u:
+                    merged.append(s)
+                else:
+                    raise MXNetError(
+                        f"Cannot change shape of {self.name} from {self._shape} to {new_shape}"
+                    )
+            self._shape = tuple(merged)
+        else:
+            self._shape = tuple(
+                u if s in (0, None) else s for s, u in zip(self._shape, new_shape)
+            )
+        if self._deferred_init is not None and _shape_known(self._shape):
+            self._finish_deferred_init()
+
+    # -- init -------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or initializer.Uniform()
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = [Context(c) for c in ctx]
+        if not _shape_known(self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape {self._shape} and allow_deferred_init=False"
+            )
+        self._init_impl(init, ctx, default_init)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = None
+        self._init_impl(init, ctx, default_init)
+
+    def _init_impl(self, init, ctx_list, default_init):
+        self._ctx_list = list(ctx_list)
+        # run the initializer once on a host buffer, replicate to all ctx
+        host = NDArray(jnp.zeros(self._shape, jnp.dtype(self.dtype)), ctx=cpu())
+        used_init = self.init if self.init is not None else (init or default_init)
+        if used_init is not None:
+            if isinstance(used_init, str):
+                used_init = initializer.create(used_init)
+            used_init(initializer.InitDesc(self.name), host)
+        self._data = {}
+        self._grad = {}
+        for c in self._ctx_list:
+            arr = host.copyto(c)
+            self._data[c] = arr
+            if self.grad_req != "null":
+                arr.attach_grad(self.grad_req)
+                self._grad[c] = arr.grad
+
+    def _load_init(self, data, ctx=None, cast_dtype=False, dtype_source="current"):
+        """Load from a saved NDArray (reference: ``Parameter._load_init``)."""
+        if self._shape is not None and _shape_known(self._shape):
+            if tuple(data.shape) != tuple(self._shape):
+                raise MXNetError(
+                    f"Failed loading Parameter {self.name}: shape mismatch "
+                    f"saved {data.shape} vs expected {self._shape}"
+                )
+        else:
+            self._shape = tuple(data.shape)
+        if cast_dtype and dtype_source == "current":
+            data = data.astype(self.dtype)
+        else:
+            self.dtype = str(data.dtype)
+        if ctx is None:
+            ctx = self._ctx_list or [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._deferred_init = None
+        if self._data is None:
+            self._ctx_list = list(ctx)
+            self._data = {}
+            self._grad = {}
+            for c in self._ctx_list:
+                arr = data.copyto(c)
+                self._data[c] = arr
+                if self.grad_req != "null":
+                    arr.attach_grad(self.grad_req)
+                    self._grad[c] = arr.grad
+        else:
+            for c, arr in self._data.items():
+                arr._set_data(data.data.astype(arr.dtype))
+
+    # -- access -----------------------------------------------------------
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet because "
+                    "initialization was deferred. Actual initialization happens "
+                    "during the first forward pass."
+                )
+            raise MXNetError(
+                f"Parameter {self.name} has not been initialized. You should "
+                "initialize parameters and create a Trainer first."
+            )
+
+    def _resolve_ctx(self, ctx):
+        if ctx is None:
+            if len(self._data) == 1:
+                return next(iter(self._data))
+            ctx = current_context()
+        ctx = Context(ctx)
+        if ctx not in self._data:
+            raise MXNetError(
+                f"Parameter {self.name} was not initialized on context {ctx}; "
+                f"it is on {list(self._data)}"
+            )
+        return ctx
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        return self._data[self._resolve_ctx(ctx)]
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data[c] for c in self._ctx_list]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized(ctx)
+        if self.grad_req == "null":
+            raise MXNetError(f"Parameter {self.name} has grad_req='null'")
+        return self._data[self._resolve_ctx(ctx)].grad
+
+    def list_grad(self):
+        self._check_initialized()
+        return [self._data[c].grad for c in self._ctx_list]
+
+    def list_ctx(self):
+        if self._data is None and self._deferred_init is not None:
+            return self._deferred_init[1]
+        self._check_initialized()
+        return self._ctx_list
+
+    def set_data(self, data):
+        self.shape = tuple(data.shape)
+        if self._data is None:
+            if self._deferred_init is not None:
+                self._finish_deferred_init()
+            else:
+                raise MXNetError(f"Parameter {self.name} not initialized")
+        raw = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+        for arr in self._data.values():
+            arr._set_data(raw.astype(arr.dtype))
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._data.values():
+            if g.grad is not None:
+                g.grad._set_data(jnp.zeros(g.shape, g.grad.data.dtype))
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = [Context(c) for c in ctx]
+        if self._data is not None:
+            data = next(iter(self._data.values()))
+            self._data = None
+            self._grad = None
+            self._load_init(data, ctx)
+        elif self._deferred_init is not None:
+            init, _, default_init = self._deferred_init
+            self._deferred_init = (init, ctx, default_init)
+
+    def cast(self, dtype):
+        self.dtype = dtype if isinstance(dtype, str) else _np.dtype(dtype).name
+        if self._data is None:
+            return
+        for arr in self._data.values():
+            arr._set_data(arr.data.astype(jnp.dtype(self.dtype)))
+            if arr.grad is not None:
+                arr.grad._set_data(arr.grad.data.astype(jnp.dtype(self.dtype)))
+
+    def var(self):
+        from ..symbol.symbol import var
+
+        return var(self.name, shape=self._shape, dtype=self.dtype)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (reference: ``gluon.Constant``)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value))
+        self.value = value
+        super().__init__(
+            name, grad_req="null", shape=value.shape,
+            dtype=str(value.dtype), init=_ConstantInit(value),
+        )
+
+
+class _ConstantInit(initializer.Initializer):
+    def __init__(self, value):
+        super().__init__()
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        arr._set_data(self.value.data)
+
+    _init_default = _init_weight
+
+
+class ParameterDict:
+    """Prefix-scoped parameter dictionary (reference: ``ParameterDict``)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __repr__(self):
+        s = "\n".join(repr(p) for p in self._params.values())
+        return f"{self._prefix}(\n{s}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        if name in self._params:
+            param = self._params[name]
+            for k, v in kwargs.items():
+                if k == "shape" and param.shape is not None:
+                    param.shape = v
+                elif getattr(param, k, None) in (None,) and v is not None:
+                    setattr(param, k, v)
+            return param
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        param = Parameter(name, **kwargs)
+        self._params[name] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        if name in self._params:
+            return self._params[name]
+        if value is None:
+            raise MXNetError(f"No constant named {name}")
+        c = Constant(name, value)
+        self._params[name] = c
+        return c
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"Parameter name {k} conflicts")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init or initializer.Uniform(),
+                         force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import ndarray as nd
+
+        arg_dict = {}
+        for param in self._params.values():
+            block = param.list_data()
+            weight = block[0]
+            name = param.name
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg_dict[name] = weight
+        nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix="", cast_dtype=False,
+             dtype_source="current"):
+        from ..ndarray import ndarray as nd
+
+        loaded = nd.load(filename)
+        loaded = {restore_prefix + k.replace("arg:", "").replace("aux:", ""): v
+                  for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in loaded:
+                    raise MXNetError(f"Parameter {name} missing in file {filename}")
+        for name, data in loaded.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(f"Parameter {name} in file but not in dict")
+                continue
+            self._params[name]._load_init(data, ctx, cast_dtype=cast_dtype,
+                                          dtype_source=dtype_source)

@@ -1,0 +1,168 @@
+"""Fused recurrent layers (reference: ``gluon/rnn/rnn_layer.py``; the fused
+``RNN`` op replaces cuDNN RNN — see ``mxnet_tpu.ops.nn.rnn_fused``).
+
+Parameter layout matches the reference (separate ``{l,r}{i}_i2h_weight`` /
+``h2h_weight`` / biases, flattened in cuDNN canonical order at call time),
+so reference checkpoints load unchanged.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ... import initializer
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), f"Invalid layout {layout}"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][: self._dir]:
+                self._register_param(
+                    f"{j}{i}_i2h_weight", (ng * nh, ni),
+                    i2h_weight_initializer)
+                self._register_param(
+                    f"{j}{i}_h2h_weight", (ng * nh, nh),
+                    h2h_weight_initializer)
+                self._register_param(
+                    f"{j}{i}_i2h_bias", (ng * nh,), i2h_bias_initializer)
+                self._register_param(
+                    f"{j}{i}_h2h_bias", (ng * nh,), h2h_bias_initializer)
+            ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        return p
+
+    def _alias(self):
+        return self._mode
+
+    def __repr__(self):
+        return (f"{self.__class__.__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers})")
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ...ndarray import ndarray as nd
+
+        states = []
+        for info in self.state_info(batch_size):
+            if func is None:
+                states.append(nd.zeros(shape=info["shape"], **kwargs))
+            else:
+                states.append(func(name=f"{self.prefix}begin_state",
+                                   shape=info["shape"], **kwargs))
+        return states
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[2] if self._layout == "TNC" else x.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        for i in range(self._num_layers):
+            inp = ni if i == 0 else nh * self._dir
+            for j in ["l", "r"][: self._dir]:
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, inp)
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        batch_size = inputs.shape[self._layout.find("N")]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size, ctx=inputs.ctx,
+                                      dtype=str(inputs.dtype))
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        out, out_states = self._forward_kernel(F, inputs, list(states), params)
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        return out if skip_states else (out, out_states)
+
+    def _flat_params(self, F, params):
+        order = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                order.append(params[f"{j}{i}_i2h_weight"])
+                order.append(params[f"{j}{i}_h2h_weight"])
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                order.append(params[f"{j}{i}_i2h_bias"])
+                order.append(params[f"{j}{i}_h2h_bias"])
+        return F.concat(*[F.reshape(p, shape=(-1,)) for p in order], dim=0)
+
+    def _forward_kernel(self, F, inputs, states, params):
+        flat = self._flat_params(F, params)
+        if self._mode == "lstm":
+            out, hN, cN = F.RNN(inputs, flat, states[0], states[1],
+                                state_size=self._hidden_size,
+                                num_layers=self._num_layers, mode=self._mode,
+                                bidirectional=self._dir == 2, p=self._dropout)
+            return out, [hN, cN]
+        out, hN = F.RNN(inputs, flat, states[0], None,
+                        state_size=self._hidden_size,
+                        num_layers=self._num_layers, mode=self._mode,
+                        bidirectional=self._dir == 2, p=self._dropout)
+        return out, [hN]
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (reference: ``gluon.rnn.RNN``)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

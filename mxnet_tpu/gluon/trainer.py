@@ -1,0 +1,185 @@
+"""Gluon Trainer: Parameters <-> KVStore <-> Optimizer bridge.
+
+Reference: ``python/mxnet/gluon/trainer.py`` (symbols ``Trainer.step``,
+``_allreduce_grads``, ``_update``). Multi-device aggregation goes through
+the KVStore exactly as in the reference; on a TPU mesh the ``dist_tpu_sync``
+store lowers push/pull to an ICI allreduce (SURVEY.md §2.5 P2/P4).
+"""
+
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..base import MXNetError
+from ..kvstore import create as _create_kvstore
+from ..kvstore.base import KVStoreBase
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[k] for k in sorted(params.keys())]
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a list/dict/ParameterDict of Parameter")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise MXNetError(f"invalid parameter {p}")
+            self._params.append(p)
+            self._param2idx[p.name] = i
+        self._compression_params = compression_params
+        self._contexts = self._check_contexts()
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_type = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._params_to_init = list(self._params)
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx() if param._data is not None or param._deferred_init else None
+            if ctx is None:
+                continue
+            if contexts is not None and set(map(str, ctx)) != set(map(str, contexts)):
+                raise MXNetError("All Parameters must be initialized on the same contexts")
+            contexts = ctx
+        return contexts or []
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params:
+                raise MXNetError(
+                    "optimizer_params must be empty if optimizer is an instance"
+                )
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+
+    def _init_kvstore(self):
+        if isinstance(self._kvstore_type, KVStoreBase):
+            self._kvstore = self._kvstore_type
+        elif self._kvstore_type is None:
+            self._kvstore = None
+        else:
+            n_dev = max(len(self._contexts), 1)
+            if n_dev > 1 or (isinstance(self._kvstore_type, str)
+                             and self._kvstore_type.startswith("dist")):
+                self._kvstore = _create_kvstore(self._kvstore_type)
+            else:
+                self._kvstore = None  # single device: in-process update
+        if self._kvstore is not None and self._compression_params:
+            self._kvstore.set_gradient_compression(self._compression_params)
+        self._kv_initialized = True
+
+    def _init_params(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        remaining = []
+        for param in self._params_to_init:
+            if param._deferred_init is not None:
+                remaining.append(param)
+                continue
+            if self._kvstore is not None and param._data is not None:
+                idx = self._param2idx[param.name]
+                self._kvstore.init(idx, param.list_data()[0])
+        self._params_to_init = remaining
+        if not self._contexts:
+            self._contexts = self._check_contexts()
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Scale grads by 1/batch_size, aggregate across devices, update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            grads = param.list_grad()
+            self._kvstore.pushpull(i, grads, out=grads)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._params_to_init:
+            self._init_params()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            datas = param.list_data()
+            grads = param.list_grad()
+            # after allreduce every device holds the aggregated grad:
+            # run the update once, broadcast the new weight
+            if not hasattr(param, "_opt_state"):
+                param._opt_state = self._optimizer.create_state_multi_precision(
+                    i, datas[0]
+                )
+            self._optimizer.update_multi_precision(i, datas[0], grads[0],
+                                                   param._opt_state)
+            for d in datas[1:]:
+                d._set_data(datas[0].data)
+
+    def save_states(self, fname):
+        import pickle
+
+        states = {
+            i: getattr(p, "_opt_state", None) for i, p in enumerate(self._params)
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(
+                {
+                    "states": states,
+                    "update_counts": self._optimizer._index_update_count,
+                    "num_update": self._optimizer.num_update,
+                },
+                f,
+            )
+
+    def load_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        for i, p in enumerate(self._params):
+            if blob["states"].get(i) is not None:
+                p._opt_state = blob["states"][i]
+        self._optimizer._index_update_count = blob["update_counts"]
+        self._optimizer.num_update = blob["num_update"]

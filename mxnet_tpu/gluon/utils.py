@@ -1,0 +1,90 @@
+"""Gluon utilities (reference: ``python/mxnet/gluon/utils.py``)."""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray, array as _array
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along ``batch_axis`` into ``num_slice`` pieces (reference:
+    ``gluon.utils.split_data``)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}"
+        )
+    step = size // num_slice
+    if not even_split and size % num_slice != 0:
+        slices = [
+            data.slice_axis(axis=batch_axis, begin=i * step,
+                            end=(i + 1) * step if i < num_slice - 1 else size)
+            for i in range(num_slice)
+        ]
+    else:
+        slices = [
+            data.slice_axis(axis=batch_axis, begin=i * step, end=(i + 1) * step)
+            for i in range(num_slice)
+        ]
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split and move one shard to each ctx (reference: ``split_and_load``;
+    this is the P1 data-parallel sharding entry, SURVEY.md §2.5)."""
+    if not isinstance(data, NDArray):
+        data = _array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so their joint L2 norm <= max_norm."""
+    import jax.numpy as jnp
+
+    total = sum(float(jnp.sum(jnp.square(a.data))) for a in arrays)
+    total_norm = total ** 0.5
+    if check_isfinite and not _np.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf found in clip_global_norm")
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a.data * scale)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Download a file (reference: ``gluon.utils.download``). This
+    environment is zero-egress; raises with a clear message if attempted."""
+    raise MXNetError(
+        f"download({url}) is unavailable: no network egress. Place files "
+        "locally and pass a local path instead."
+    )
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s is not None and s > 0 for s in shape)

@@ -1,0 +1,33 @@
+"""``mx.image`` (reference: ``python/mxnet/image/image.py``).
+
+Codec backend: Pillow when available (the reference links OpenCV — not in
+this image); resize/crop math runs through ``jax.image`` so augmentation can
+execute on-device. Legacy ``ImageIter`` included for Module-era scripts.
+"""
+
+from .image import (  # noqa: F401
+    imdecode,
+    imencode,
+    imread,
+    imresize,
+    imrotate,
+    resize_short,
+    fixed_crop,
+    center_crop,
+    random_crop,
+    random_size_crop,
+    color_normalize,
+    CreateAugmenter,
+    Augmenter,
+    ResizeAug,
+    ForceResizeAug,
+    RandomCropAug,
+    CenterCropAug,
+    HorizontalFlipAug,
+    CastAug,
+    ColorNormalizeAug,
+    BrightnessJitterAug,
+    ContrastJitterAug,
+    SaturationJitterAug,
+    ImageIter,
+)

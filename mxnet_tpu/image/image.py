@@ -1,0 +1,426 @@
+"""Image IO, resize/crop, augmenters, and the legacy ImageIter."""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as _array
+
+
+def _pil():
+    try:
+        from PIL import Image
+
+        return Image
+    except ImportError as e:  # pragma: no cover
+        raise MXNetError(
+            "image codec requires Pillow, which is unavailable; decode "
+            "images ahead of time or install Pillow"
+        ) from e
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode an image byte buffer into an HWC uint8 NDArray."""
+    Image = _pil()
+    img = Image.open(_io.BytesIO(bytes(buf)))
+    if flag == 0:
+        img = img.convert("L")
+        arr = _np.asarray(img)[:, :, None]
+    else:
+        img = img.convert("RGB")
+        arr = _np.asarray(img)
+        if not to_rgb:
+            arr = arr[:, :, ::-1]  # BGR like the reference's cv2 default
+    return _array(arr.copy(), dtype="uint8")
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    Image = _pil()
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = _np.asarray(img).astype("uint8")
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[:, :, 0]
+    pimg = Image.fromarray(img)
+    bio = _io.BytesIO()
+    fmt = "JPEG" if "jp" in img_fmt.lower() else "PNG"
+    pimg.save(bio, format=fmt, quality=quality)
+    return bio.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+_INTERP = {0: "nearest", 1: "linear", 2: "cubic", 3: "linear", 4: "linear",
+           9: "linear", 10: "linear"}
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image to (h, w) via jax.image (device-capable)."""
+    method = _INTERP.get(interp, "linear")
+    raw = src.data if isinstance(src, NDArray) else jnp.asarray(src)
+    out = jax.image.resize(raw.astype(jnp.float32), (h, w, raw.shape[2]),
+                           method=method)
+    if raw.dtype == jnp.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    else:
+        out = out.astype(raw.dtype)
+    return NDArray(out)
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    raw = src.data if isinstance(src, NDArray) else jnp.asarray(src)
+    import math
+
+    theta = math.radians(float(rotation_degrees))
+    h, w = raw.shape[0], raw.shape[1]
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    yr = (ys - cy) * math.cos(theta) - (xs - cx) * math.sin(theta) + cy
+    xr = (ys - cy) * math.sin(theta) + (xs - cx) * math.cos(theta) + cx
+    yi = jnp.clip(jnp.round(yr), 0, h - 1).astype(jnp.int32)
+    xi = jnp.clip(jnp.round(xr), 0, w - 1).astype(jnp.int32)
+    valid = (yr >= 0) & (yr <= h - 1) & (xr >= 0) & (xr <= w - 1)
+    out = raw[yi, xi]
+    out = jnp.where(valid[..., None], out, 0)
+    return NDArray(out)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = NDArray(src.data[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - (mean if isinstance(mean, NDArray) else _array(_np.asarray(mean)))
+    if std is not None:
+        src = src / (std if isinstance(std, NDArray) else _array(_np.asarray(std)))
+    return src
+
+
+# ---------------------------------------------------------------------------
+# augmenters (reference: ``image.py:Augmenter`` family)
+# ---------------------------------------------------------------------------
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return src.flip(axis=1)
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = float(src.mean().asscalar())
+        return src * alpha + gray * (1 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        coef = _array(_np.array([[[0.299, 0.587, 0.114]]], dtype="float32"))
+        gray = (src * coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1 - alpha)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2):
+    """Build the standard augmenter list (reference: ``CreateAugmenter``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(_RandomSizedCropAug(crop_size, inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None and (std is not None or isinstance(mean, _np.ndarray)):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class _RandomSizedCropAug(Augmenter):
+    def __init__(self, size, interp):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, (0.08, 1.0),
+                                (3 / 4.0, 4 / 3.0), self.interp)[0]
+
+
+class ImageIter:
+    """Legacy python image iterator over .rec or .lst (reference:
+    ``image.ImageIter``)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        from ..io import DataBatch, DataDesc  # noqa
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
+            self.data_shape, **{k: v for k, v in kwargs.items()
+                                if k in ("resize", "rand_crop", "rand_resize",
+                                         "rand_mirror", "mean", "std")})
+        self.imgrec = None
+        self.seq = None
+        self.imglist = {}
+        if path_imgrec:
+            from ..recordio import MXIndexedRecordIO
+
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = _np.array([float(x) for x in parts[1:-1]],
+                                      dtype="float32")
+                    self.imglist[int(parts[0])] = (label, parts[-1])
+            self.seq = list(self.imglist.keys())
+        elif imglist is not None:
+            for i, item in enumerate(imglist):
+                self.imglist[i] = (_np.array(item[0], dtype="float32")
+                                   if not _np.isscalar(item[0])
+                                   else _np.array([item[0]], dtype="float32"),
+                                   item[1])
+            self.seq = list(self.imglist.keys())
+        else:
+            raise MXNetError("either path_imgrec, path_imglist or imglist required")
+        self.path_root = path_root
+        self.provide_data = [("data", (batch_size,) + self.data_shape)]
+        self.provide_label = [("label", (batch_size, label_width))]
+        self.cursor = 0
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cursor = 0
+
+    def next_sample(self):
+        if self.cursor >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cursor]
+        self.cursor += 1
+        if self.imgrec is not None:
+            from ..recordio import unpack
+
+            header, img = unpack(self.imgrec.read_idx(idx))
+            label = header.label
+            return label, img
+        label, fname = self.imglist[idx]
+        with open(os.path.join(self.path_root, fname), "rb") as f:
+            return label, f.read()
+
+    def next(self):
+        batch_data = []
+        batch_label = []
+        try:
+            while len(batch_data) < self.batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                for aug in self.auglist:
+                    data = aug(data)
+                batch_data.append(jnp.transpose(data.data.astype(self.dtype),
+                                                (2, 0, 1)))
+                batch_label.append(_np.atleast_1d(_np.asarray(label)))
+        except StopIteration:
+            if not batch_data:
+                raise
+            while len(batch_data) < self.batch_size:  # pad
+                batch_data.append(batch_data[-1])
+                batch_label.append(batch_label[-1])
+        from ..io import DataBatch
+
+        data = NDArray(jnp.stack(batch_data))
+        label = _array(_np.stack(batch_label))
+        return DataBatch(data=[data], label=[label], pad=0)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self

@@ -1,0 +1,14 @@
+"""``mx.io`` — legacy DataIter API (reference: ``python/mxnet/io/io.py``)."""
+
+from .io import (  # noqa: F401
+    DataDesc,
+    DataBatch,
+    DataIter,
+    NDArrayIter,
+    ResizeIter,
+    PrefetchingIter,
+    MXDataIter,
+    CSVIter,
+    ImageRecordIter,
+    MNISTIter,
+)

@@ -1,0 +1,7 @@
+"""``mx.kv`` (reference: ``python/mxnet/kvstore/``)."""
+
+from .base import KVStoreBase, create, register_kvstore  # noqa: F401
+from .local import KVStoreLocal  # noqa: F401
+from .dist import KVStoreDistTPU, init_distributed  # noqa: F401
+
+KVStore = KVStoreBase

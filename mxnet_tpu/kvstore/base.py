@@ -1,0 +1,103 @@
+"""KVStore base interface + registry.
+
+Reference: ``include/mxnet/kvstore.h`` / ``python/mxnet/kvstore/base.py``
+(symbols ``KVStore::Create``, ``KVStoreBase``). Types supported here:
+``local`` / ``device`` (single-process, multi-device aggregation),
+``dist_tpu_sync`` (SPMD allreduce over ICI/DCN — the TPU-native replacement
+for ``dist_sync``/``nccl``/parameter-server, SURVEY.md §2.5 P15).
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_KV_REGISTRY = {}
+
+
+def register_kvstore(*names):
+    def deco(klass):
+        for n in names:
+            _KV_REGISTRY[n] = klass
+        return klass
+
+    return deco
+
+
+class KVStoreBase:
+    """Abstract KVStore (reference: ``KVStoreBase`` ABC, 1.7+)."""
+
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise NotImplementedError
+
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+    def barrier(self):
+        pass
+
+    @staticmethod
+    def is_capable(capability):
+        return True
+
+
+def create(name="local"):
+    """Create a KVStore (reference: ``mx.kv.create``)."""
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    # legacy GPU-era names map onto the TPU-native stores
+    alias = {
+        "local_allreduce_cpu": "local",
+        "local_allreduce_device": "device",
+        "nccl": "device",
+        "dist": "dist_tpu_sync",
+        "dist_sync": "dist_tpu_sync",
+        "dist_device_sync": "dist_tpu_sync",
+        "dist_sync_device": "dist_tpu_sync",
+        "dist_async": "dist_tpu_sync",
+        "horovod": "dist_tpu_sync",
+    }
+    key = alias.get(name, name)
+    if key not in _KV_REGISTRY:
+        raise MXNetError(f"unknown KVStore type {name}")
+    store = _KV_REGISTRY[key]()
+    store._type = name
+    return store

@@ -1,0 +1,153 @@
+"""Single-process KVStore: multi-device gradient aggregation.
+
+Reference: ``src/kvstore/kvstore_local.h`` + ``comm.h`` (``CommCPU``/
+``CommDevice``/``CommDeviceTree``). The reference needed explicit reduce
+trees over PCIe; on TPU, XLA's ``psum``/addition graphs pick the reduction
+topology, so aggregation is a jitted tree-sum followed by broadcast.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase, register_kvstore
+
+
+@jax.jit
+def _tree_sum(arrays):
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = acc + a
+    return acc
+
+
+@register_kvstore("local", "device")
+class KVStoreLocal(KVStoreBase):
+    """In-process store. ``device`` and ``local`` collapse to the same
+    implementation: XLA owns placement and reduction topology."""
+
+    def __init__(self):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states = {}
+
+    def _key(self, key):
+        return str(key)
+
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        self._store[self._key(key)] = value.copy()
+
+    def _merge(self, values):
+        if isinstance(values, NDArray):
+            return values
+        if len(values) == 1:
+            return values[0]
+        # cross-device sum: gather to first device, tree-add (jitted)
+        dev = values[0].data.device if hasattr(values[0].data, "device") else None
+        raws = [v.data if v.data.device == dev else jax.device_put(v.data, dev)
+                for v in values]
+        return NDArray(_tree_sum(raws), ctx=values[0].ctx)
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        k = self._key(key)
+        if k not in self._store:
+            raise MXNetError(f"key {key} has not been initialized")
+        merged = self._merge(value)
+        if self._updater is not None:
+            self._updater(int(key) if k.isdigit() else k, merged, self._store[k])
+        elif self._optimizer is not None:
+            idx = int(key) if k.isdigit() else k
+            if idx not in self._opt_states:
+                self._opt_states[idx] = self._optimizer.create_state_multi_precision(
+                    idx, self._store[k]
+                )
+            self._optimizer.update_multi_precision(
+                idx, self._store[k], merged, self._opt_states[idx]
+            )
+        else:
+            self._store[k]._set_data(merged.data.astype(self._store[k].dtype))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            if out is not None and len(out) and isinstance(out[0], (list, tuple)):
+                for k, o in zip(key, out):
+                    self.pull(k, out=o, priority=priority)
+            else:
+                for k, o in zip(key, out):
+                    self.pull(k, out=o, priority=priority)
+            return
+        k = self._key(key)
+        stored = self._store[k]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._set_data(jax.device_put(stored.data, o.ctx.jax_device).astype(o.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Aggregate ``value`` across devices and broadcast into ``out``
+        WITHOUT touching the stored weight (Trainer's allreduce path)."""
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i], out=None if out is None else out[i],
+                              priority=priority)
+            return
+        if self._updater is not None or self._optimizer is not None:
+            # update-on-kvstore semantics: push grads, pull weights
+            self.push(key, value, priority)
+            if out is not None:
+                self.pull(key, out=out, priority=priority)
+            return
+        merged = self._merge(value)
+        if out is None:
+            self.push(key, merged, priority)
+        else:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o in outs:
+                o._set_data(
+                    jax.device_put(merged.data, o.ctx.jax_device).astype(o.dtype)
+                )
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from ..ndarray.sparse import RowSparseNDArray, retain_rows
+
+        k = self._key(key)
+        stored = self._store[k]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        ids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        for o, rid in zip(outs, ids):
+            retain_rows(stored, rid, out=o)
+
+    def set_updater(self, updater):
+        self._updater = updater
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = compression_params  # applied in dist store
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        import pickle
+
+        with open(fname, "wb") as f:
+            pickle.dump(self._opt_states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            self._opt_states = pickle.load(f)

@@ -1,0 +1,9 @@
+"""``mx.mod`` (reference: ``python/mxnet/module/``)."""
+
+from .module import (  # noqa: F401
+    BaseModule,
+    Module,
+    BucketingModule,
+    save_checkpoint,
+    load_checkpoint,
+)

@@ -1,0 +1,474 @@
+"""Legacy Module API.
+
+Reference: ``python/mxnet/module/`` (symbols ``BaseModule.fit``, ``Module``,
+``BucketingModule``). Implemented over the Symbol Executor; the
+data-parallel multi-executor machinery of the reference collapses to one
+XLA-sharded executor (SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as _np
+
+from .. import metric as _metric
+from .. import optimizer as _opt
+from ..base import MXNetError
+from ..callback import BatchEndParam
+from ..context import cpu, current_context
+from ..initializer import Uniform
+from ..io import DataBatch, DataDesc
+from ..ndarray.ndarray import NDArray, array as _array, zeros as _zeros
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self._symbol = None
+
+    # -- high-level API ---------------------------------------------------
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def score(self, eval_data, eval_metric, num_batch=None, batch_end_callback=None,
+              score_end_callback=None, reset=True, epoch=0, sparse_row_id_fn=None):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        actual_num_batch = 0
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            self.update_metric(eval_metric, eval_batch.label)
+            if batch_end_callback is not None:
+                for cb in _as_list(batch_end_callback):
+                    cb(BatchEndParam(epoch, nbatch, eval_metric))
+            actual_num_batch += 1
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        output_list = []
+        for nbatch, eval_batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(eval_batch, is_train=False)
+            pad = eval_batch.pad or 0
+            outputs = [
+                out[0:out.shape[0] - pad] for out in self.get_outputs()
+            ]
+            output_list.append(outputs)
+        if not output_list:
+            return output_list
+        if merge_batches:
+            num_outputs = len(output_list[0])
+            merged = [
+                _concat([o[i] for o in output_list]) for i in range(num_outputs)
+            ]
+            if num_outputs == 1 and not always_output_list:
+                return merged[0]
+            return merged
+        return output_list
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=Uniform(0.01), arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None, sparse_row_id_fn=None):
+        """The classic training loop (reference: ``BaseModule.fit``)."""
+        assert num_epoch is not None, "please specify number of epochs"
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=optimizer_params)
+        if validation_metric is None:
+            validation_metric = eval_metric
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            end_of_batch = False
+            data_iter = iter(train_data)
+            next_data_batch = next(data_iter)
+            while not end_of_batch:
+                data_batch = next_data_batch
+                self.forward_backward(data_batch)
+                self.update()
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    end_of_batch = True
+                self.update_metric(eval_metric, data_batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(BatchEndParam(epoch, nbatch, eval_metric))
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            arg_p, aux_p = self.get_params()
+            self.set_params(arg_p, aux_p)
+            if epoch_end_callback is not None:
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_p, aux_p)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric,
+                                 score_end_callback=eval_end_callback,
+                                 batch_end_callback=eval_batch_end_callback,
+                                 epoch=epoch)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+            train_data.reset()
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def _concat(arrays):
+    import jax.numpy as jnp
+
+    return NDArray(jnp.concatenate([a.data for a in arrays], axis=0))
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context or current_context()
+        if isinstance(self._context, (list, tuple)):
+            self._context = self._context[0]  # XLA shards; one logical ctx
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._updater_states = {}
+        arg_names = symbol.list_arguments()
+        self._param_names = [
+            n for n in arg_names
+            if n not in self._data_names and n not in self._label_names
+        ]
+        self._aux_names = symbol.list_auxiliary_states()
+
+    # -- binding ----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        self.for_training = for_training
+        shapes = {}
+        for d in data_shapes:
+            name, shape = (d.name, d.shape) if isinstance(d, DataDesc) else (d[0], d[1])
+            shapes[name] = shape
+        if label_shapes:
+            for d in label_shapes:
+                name, shape = (d.name, d.shape) if isinstance(d, DataDesc) else (d[0], d[1])
+                shapes[name] = shape
+        self._data_shapes = dict(shapes)
+        self._exec = self._symbol.simple_bind(
+            ctx=self._context, grad_req=grad_req if for_training else "null",
+            **shapes)
+        # don't compute grads for data/label
+        for n in self._data_names + self._label_names:
+            if n in self._exec.grad_dict:
+                del self._exec.grad_dict[n]
+        self.binded = True
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        assert self.binded
+        from ..initializer import InitDesc
+
+        for name in self._param_names:
+            arr = self._exec.arg_dict[name]
+            if arg_params is not None and name in arg_params:
+                arr._set_data(arg_params[name].data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+            elif not allow_missing:
+                raise MXNetError(f"no initializer and no value for {name}")
+        for name in self._aux_names:
+            arr = self._exec.aux_dict[name]
+            if aux_params is not None and name in aux_params:
+                arr._set_data(aux_params[name].data)
+            elif initializer is not None:
+                initializer(InitDesc(name), arr)
+        self.params_initialized = True
+
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        arg = {n: self._exec.arg_dict[n].copy() for n in self._param_names}
+        aux = {n: self._exec.aux_dict[n].copy() for n in self._aux_names}
+        return arg, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        if not self.params_initialized:
+            self.init_params(None, arg_params, aux_params, allow_missing, True)
+            return
+        for n, v in (arg_params or {}).items():
+            if n in self._exec.arg_dict:
+                self._exec.arg_dict[n]._set_data(v.data)
+            elif not allow_extra:
+                raise MXNetError(f"unknown parameter {n}")
+        for n, v in (aux_params or {}).items():
+            if n in self._exec.aux_dict:
+                self._exec.aux_dict[n]._set_data(v.data)
+            elif not allow_extra:
+                raise MXNetError(f"unknown aux state {n}")
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            idx2name = dict(enumerate(self._param_names))
+            optimizer = _opt.create(optimizer, param_idx2name=idx2name,
+                                    **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater_states = {}
+        self.optimizer_initialized = True
+
+    # -- compute ----------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.binded and self.params_initialized and self.optimizer_initialized
+        for i, name in enumerate(self._param_names):
+            if name in self._fixed_param_names:
+                continue
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            weight = self._exec.arg_dict[name]
+            if i not in self._updater_states:
+                self._updater_states[i] = \
+                    self._optimizer.create_state_multi_precision(i, weight)
+            self._optimizer.update_multi_precision(i, weight, grad,
+                                                   self._updater_states[i])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    # -- checkpointing ----------------------------------------------------
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save(f"{prefix}-symbol.json")
+        arg, aux = self.get_params()
+        save_dict = {f"arg:{k}": v for k, v in arg.items()}
+        save_dict.update({f"aux:{k}": v for k, v in aux.items()})
+        from ..ndarray import ndarray as nd
+
+        nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+        if save_optimizer_states:
+            import pickle
+
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                pickle.dump(self._updater_states, f)
+
+    def save_optimizer_states(self, fname):
+        import pickle
+
+        with open(fname, "wb") as f:
+            pickle.dump(self._updater_states, f)
+
+    def load_optimizer_states(self, fname):
+        import pickle
+
+        with open(fname, "rb") as f:
+            self._updater_states = pickle.load(f)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        sym, arg, aux = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = arg
+        mod._aux_params = aux
+        mod._preloaded = (arg, aux)
+        orig_init = mod.init_params
+
+        def init_params(initializer=Uniform(0.01), arg_params=None,
+                        aux_params=None, **kw):
+            orig_init(initializer, arg_params or arg, aux_params or aux, **kw)
+
+        mod.init_params = init_params
+        if load_optimizer_states:
+            mod._preload_states = f"{prefix}-{epoch:04d}.states"
+        return mod
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in aux_params.items()})
+    from ..ndarray import ndarray as nd
+
+    nd.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    from ..symbol import symbol as sym_mod
+    from ..ndarray import ndarray as nd
+
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    saved = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in saved.items():
+        tp, name = k.split(":", 1)
+        if tp == "arg":
+            arg_params[name] = v
+        else:
+            aux_params[name] = v
+    return symbol, arg_params, aux_params
+
+
+class BucketingModule(BaseModule):
+    """Bucketed-sequence training (reference: ``BucketingModule``).
+
+    TPU note: one executable compiles per bucket key — identical to the
+    reference's per-bucket executors; prefer padded pipelines on TPU.
+    """
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._shared_params = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol if self._curr_module else None
+
+    def _get_module(self, bucket_key, data_shapes, label_shapes):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(sym, data_names, label_names, self.logger,
+                         self._context, **self._kwargs)
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self._shared_params is not None:
+                mod.init_params(None, *self._shared_params, allow_missing=True,
+                                force_init=True)
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        self.for_training = for_training
+        self._curr_module = self._get_module(self._default_bucket_key,
+                                             data_shapes, label_shapes)
+        self.binded = True
+
+    def init_params(self, *args, **kwargs):
+        self._curr_module.init_params(*args, **kwargs)
+        self._shared_params = self._curr_module.get_params()
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self._curr_module.init_optimizer(*args, **kwargs)
+        self._opt_args = (args, kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = data_batch.bucket_key
+        prev = self._curr_module
+        module = self._get_module(key if key is not None
+                                  else self._default_bucket_key,
+                                  data_batch.provide_data or
+                                  [(n, a.shape) for n, a in
+                                   zip(self._curr_module._data_names,
+                                       data_batch.data)],
+                                  data_batch.provide_label or
+                                  ([(n, a.shape) for n, a in
+                                    zip(self._curr_module._label_names,
+                                        data_batch.label)]
+                                   if data_batch.label else None))
+        if module is not prev:
+            arg, aux = prev.get_params()
+            if not module.params_initialized:
+                module.init_params(None, arg, aux, allow_missing=True,
+                                   force_init=True)
+            else:
+                module.set_params(arg, aux)
+            if self.optimizer_initialized and not module.optimizer_initialized:
+                module.init_optimizer(*self._opt_args[0], **self._opt_args[1])
+            module._updater_states = prev._updater_states
+            module._optimizer = prev._optimizer
+        self._curr_module = module
+        module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def get_params(self):
+        return self._curr_module.get_params()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)

@@ -1,0 +1,76 @@
+"""``mx.monitor`` — per-op/per-parameter output statistics.
+
+Reference: ``python/mxnet/monitor.py`` (engine output callback). TPU-native:
+taps Gluon block outputs via forward hooks instead of engine callbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray.ndarray import NDArray
+
+
+class Monitor:
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+
+            def asum_stat(x):
+                return x.abs().mean()
+
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self._handles = []
+
+    def install(self, block):
+        """Attach to a Gluon block tree (TPU-native replacement for
+        Executor.set_monitor_callback)."""
+
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            outs = output if isinstance(output, (list, tuple)) else [output]
+            for i, o in enumerate(outs):
+                name = f"{blk.name}_output{i}"
+                if self.re_prog.match(name) and isinstance(o, NDArray):
+                    self.queue.append((self.step, name, self.stat_func(o)))
+
+        for child in block._children.values():
+            self.install(child)
+        self._handles.append(block.register_forward_hook(hook))
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        if not self.activated:
+            return []
+        self.activated = False
+        res = []
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            v = ", ".join(f"{float(v.asscalar()):.5f}" if v.size == 1 else str(v.asnumpy())
+                          for v in v_list)
+            res.append((n, k, v))
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

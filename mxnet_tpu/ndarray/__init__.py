@@ -1,0 +1,72 @@
+"""``mx.nd`` — the imperative NDArray API.
+
+Reference: ``python/mxnet/ndarray/``. The op namespace is generated from
+the registry (see ``op.py``); common ops are also attached as NDArray
+methods, matching the reference's method surface.
+"""
+
+from .ndarray import (  # noqa: F401
+    NDArray,
+    array,
+    empty,
+    zeros,
+    ones,
+    full,
+    arange,
+    eye,
+    linspace,
+    zeros_like,
+    ones_like,
+    concatenate,
+    waitall,
+    save,
+    load,
+    imdecode,
+)
+from . import op  # noqa: F401
+from .op import *  # noqa: F401,F403
+from . import random  # noqa: F401
+
+# ---------------------------------------------------------------------------
+# method attachment (reference: NDArray methods generated over the same ops)
+# ---------------------------------------------------------------------------
+
+_METHODS = [
+    "sum", "nansum", "mean", "prod", "nanprod", "max", "min", "norm",
+    "argmax", "argmin", "abs", "sign", "round", "rint", "ceil", "floor",
+    "trunc", "fix", "square", "sqrt", "rsqrt", "cbrt", "rcbrt", "exp",
+    "log", "log10", "log2", "log1p", "expm1", "sin", "cos", "tan",
+    "arcsin", "arccos", "arctan", "sinh", "cosh", "tanh", "arcsinh",
+    "arccosh", "arctanh", "degrees", "radians", "sigmoid", "softmax",
+    "log_softmax", "relu", "clip", "expand_dims", "squeeze", "flatten",
+    "transpose", "swapaxes", "flip", "tile", "repeat", "split",
+    "slice_axis", "slice_like", "take", "pick", "one_hot", "topk", "sort",
+    "argsort", "broadcast_to", "broadcast_like", "reshape_like",
+    "diag", "pad",
+]
+
+
+def _attach_method(name):
+    fn = getattr(op, name)
+
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    method.__name__ = name
+    setattr(NDArray, name, method)
+
+
+for _m in _METHODS:
+    if getattr(NDArray, _m, None) is None:
+        _attach_method(_m)
+
+
+def _reshape_method(self, *shape, **kwargs):
+    if "shape" in kwargs:
+        shape = kwargs.pop("shape")
+    elif len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+        shape = tuple(shape[0])
+    return op.reshape(self, shape=tuple(shape), **kwargs)
+
+
+NDArray.reshape = _reshape_method

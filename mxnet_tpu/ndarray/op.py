@@ -1,0 +1,137 @@
+"""The generated ``mx.nd.*`` op namespace.
+
+Reference: ``python/mxnet/ndarray/register.py`` — op stubs generated at
+import time from C-API introspection. Here the registry is Python, so the
+namespace is populated directly from :mod:`mxnet_tpu.ops.registry`.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .. import autograd
+from ..ops import registry as _registry
+from ..ops.dispatch import apply_op as _apply
+
+_THIS = sys.modules[__name__]
+
+
+import inspect as _inspect
+
+
+def _param_names(opdef):
+    """Positional parameter names of the op impl (None if *args style)."""
+    try:
+        sig = _inspect.signature(opdef.fn)
+    except (TypeError, ValueError):
+        return None
+    names = []
+    for p in sig.parameters.values():
+        if p.kind == p.VAR_POSITIONAL:
+            return None  # *args ops (concat/stack): all positional are arrays
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            names.append(p.name)
+    return names
+
+
+def _make_op(opdef):
+    pnames = _param_names(opdef)
+
+    def fn(*args, out=None, name=None, **kwargs):
+        import jax
+
+        from .ndarray import NDArray
+
+        arrays = []
+        attrs = {}
+        for i, a in enumerate(args):
+            if isinstance(a, (NDArray, jax.Array)) or a is None:
+                arrays.append(a)
+            elif pnames is not None and i < len(pnames):
+                # positional attr (e.g. x.expand_dims(0)): bind by param name
+                attrs[pnames[i]] = _hashable(a)
+            else:
+                arrays.append(a)
+        for k, v in kwargs.items():
+            if isinstance(v, (NDArray, jax.Array)):
+                arrays.append(v)
+            else:
+                attrs[k] = _hashable(v)
+        return _apply(opdef, arrays, attrs, out=out)
+
+    fn.__name__ = opdef.name
+    fn.__qualname__ = opdef.name
+    fn.__doc__ = opdef.fn.__doc__
+    return fn
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    return v
+
+
+for _name, _opdef in list(_registry.all_ops().items()):
+    if not hasattr(_THIS, _name):
+        setattr(_THIS, _name, _make_op(_opdef))
+
+
+# ---- special wrappers -----------------------------------------------------
+
+
+def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, out=None, **kw):
+    """Dropout with MXNet train/predict gating + JAX key injection."""
+    from .. import random as _random
+
+    if p <= 0.0 or (mode != "always" and not autograd.is_training()):
+        return _apply(_registry.get("identity"), (data,), {}, out=out)
+    key = _random._next_key()
+    return _apply(
+        _registry.get("Dropout"), (data, key), {"p": p, "axes": tuple(axes)}, out=out
+    )
+
+
+dropout = Dropout
+
+
+def RNN(data, parameters, state, state_cell=None, *, state_size, num_layers,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=True, out=None, **kw):
+    from .. import random as _random
+
+    p_eff = p if autograd.is_training() else 0.0
+    key = _random._next_key() if p_eff > 0.0 else None
+    arrays = [data, parameters, state,
+              state_cell if mode == "lstm" else None, key]
+    attrs = dict(state_size=state_size, num_layers=num_layers, mode=mode,
+                 bidirectional=bidirectional, p=p_eff,
+                 state_outputs=state_outputs)
+    return _apply(_registry.get("RNN"), arrays, attrs, out=out)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+              fix_gamma=True, use_global_stats=False, output_mean_var=False,
+              axis=1, cudnn_off=False, out=None, **kw):
+    training = autograd.is_training() and not use_global_stats
+    res = _apply(
+        _registry.get("BatchNorm"),
+        (data, gamma, beta, moving_mean, moving_var),
+        dict(eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+             use_global_stats=use_global_stats, output_mean_var=output_mean_var,
+             axis=axis, training=training),
+        out=out,
+    )
+    if training:
+        out_, new_mean, new_var = res
+        # write back moving stats (reference mutates aux states in-kernel)
+        moving_mean._set_data(new_mean.data)
+        moving_var._set_data(new_var.data)
+        return out_
+    return res
+
+
+batch_norm = BatchNorm
+
+# creation functions are part of the op namespace too (F.zeros, ...)
+from .ndarray import (  # noqa: E402,F401
+    array, zeros, ones, full, arange, eye, linspace, concatenate,
+)

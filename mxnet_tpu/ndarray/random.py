@@ -1,0 +1,15 @@
+"""``mx.nd.random`` namespace — re-exports the stateful-key sampling API."""
+
+from ..random import (  # noqa: F401
+    uniform,
+    normal,
+    randn,
+    randint,
+    gamma,
+    exponential,
+    poisson,
+    bernoulli,
+    multinomial,
+    shuffle,
+    seed,
+)

@@ -1,0 +1,203 @@
+"""Sparse NDArray types.
+
+Reference: ``python/mxnet/ndarray/sparse.py`` + ``src/ndarray`` sparse
+storage (``kRowSparseStorage``, ``kCSRStorage``). XLA has no sparse
+storage; TPU-native emulation (SURVEY.md §7.5): RowSparse = (indices,
+values) pair with segment-sum combine; CSR = (indptr, indices, data).
+Dense fallback is always available via ``tostype('default')``.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+
+class BaseSparseNDArray(NDArray):
+    pass
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """(indices, values) pair: row i of the logical dense array equals
+    values[k] where indices[k] == i, else zeros."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._values = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self._indices = indices if isinstance(indices, NDArray) else \
+            NDArray(jnp.asarray(indices, dtype=jnp.int64))
+        self._sshape = tuple(shape)
+        super().__init__(self._to_dense_raw(), ctx=ctx)
+
+    def _to_dense_raw(self):
+        dense = jnp.zeros(self._sshape, self._values.data.dtype)
+        idx = self._indices.data.astype(jnp.int32)
+        return dense.at[idx].add(self._values.data)
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._to_dense_raw(), ctx=self._ctx)
+        raise MXNetError(f"cannot cast row_sparse to {stype}")
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._sshape} "
+                f"nnz-rows={self._indices.shape[0]} @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        self._values = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self._indptr = indptr if isinstance(indptr, NDArray) else \
+            NDArray(jnp.asarray(indptr, dtype=jnp.int64))
+        self._indices = indices if isinstance(indices, NDArray) else \
+            NDArray(jnp.asarray(indices, dtype=jnp.int64))
+        self._sshape = tuple(shape)
+        super().__init__(self._to_dense_raw(), ctx=ctx)
+
+    def _to_dense_raw(self):
+        import numpy as np
+
+        indptr = np.asarray(self._indptr.data)
+        indices = np.asarray(self._indices.data)
+        values = np.asarray(self._values.data)
+        dense = np.zeros(self._sshape, values.dtype)
+        for i in range(self._sshape[0]):
+            sl = slice(indptr[i], indptr[i + 1])
+            dense[i, indices[sl]] = values[sl]
+        return jnp.asarray(dense)
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indices(self):
+        return self._indices
+
+    @property
+    def indptr(self):
+        return self._indptr
+
+    @property
+    def values(self):
+        return self._values
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._to_dense_raw(), ctx=self._ctx)
+        raise MXNetError(f"cannot cast csr to {stype}")
+
+    def __repr__(self):
+        return (f"\n<CSRNDArray {self._sshape} "
+                f"nnz={self._values.shape[0]} @{self._ctx}>")
+
+
+def cast_storage(arr, stype):
+    """Dense <-> sparse conversion (reference: ``cast_storage`` op)."""
+    if stype == "default":
+        return arr.tostype("default") if isinstance(arr, BaseSparseNDArray) else arr
+    dense = _np.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        nz_rows = _np.where(_np.any(dense != 0, axis=tuple(range(1, dense.ndim))))[0]
+        return RowSparseNDArray(dense[nz_rows], nz_rows, dense.shape, ctx=arr.ctx)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2D")
+        indptr = [0]
+        indices = []
+        values = []
+        for row in dense:
+            nz = _np.nonzero(row)[0]
+            indices.extend(nz.tolist())
+            values.extend(row[nz].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(_np.asarray(values, dense.dtype), indptr, indices,
+                          dense.shape, ctx=arr.ctx)
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(jnp.asarray(data, dtype), indices, shape, ctx=ctx)
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(jnp.asarray(data, dtype), indptr, indices, shape,
+                          ctx=ctx)
+    dense = _dense_array(arg1, ctx=ctx, dtype=dtype)
+    return cast_storage(dense, "csr")
+
+
+def retain(rsp, row_ids, out=None):
+    """Keep only the requested rows (reference: ``sparse.retain``)."""
+    ids = row_ids.data if isinstance(row_ids, NDArray) else jnp.asarray(row_ids)
+    ids_np = _np.asarray(ids).astype(_np.int64)
+    idx_np = _np.asarray(rsp.indices.data).astype(_np.int64) \
+        if isinstance(rsp, RowSparseNDArray) else None
+    if isinstance(rsp, RowSparseNDArray):
+        mask = _np.isin(idx_np, ids_np)
+        vals = _np.asarray(rsp.values.data)[mask]
+        kept = idx_np[mask]
+        res = RowSparseNDArray(vals, kept, rsp.shape, ctx=rsp.ctx)
+    else:
+        dense = _np.asarray(rsp.data)
+        vals = dense[ids_np]
+        res = RowSparseNDArray(vals, ids_np, dense.shape, ctx=rsp.ctx)
+    if out is not None:
+        if isinstance(out, RowSparseNDArray):
+            out._values = res._values
+            out._indices = res._indices
+            out._set_data(res._to_dense_raw())
+        else:
+            out._set_data(res._to_dense_raw())
+        return out
+    return res
+
+
+def retain_rows(dense_or_rsp, row_ids, out=None):
+    return retain(dense_or_rsp, row_ids, out=out)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr x dense, dense x rsp etc. lower to dense
+    matmul or gather-based segment ops (the factorization-machine path)."""
+    from ..ops.dispatch import invoke
+
+    return invoke("dot", lhs, rhs, transpose_a=transpose_a,
+                  transpose_b=transpose_b)

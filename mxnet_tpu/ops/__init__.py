@@ -1,0 +1,8 @@
+"""Operator implementations (pure JAX functions + registry).
+
+Importing this package registers the full op surface
+(reference: ``src/operator/**`` — see SURVEY.md §2.2).
+"""
+
+from . import registry, dispatch  # noqa: F401
+from . import math, shape_ops, nn, ctc  # noqa: F401  (registration side effects)

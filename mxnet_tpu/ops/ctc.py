@@ -1,0 +1,82 @@
+"""CTC loss (reference: ``src/operator/contrib/ctc_loss-inl.h``).
+
+TPU-native: log-space forward (alpha) recursion as a ``lax.scan`` over time;
+gradients come from JAX autodiff of the scan instead of the reference's
+hand-written beta recursion. Blank label = 0 (the reference default).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _interleave_blanks(labels):
+    """(N, L) -> (N, 2L+1) label sequence with blanks (0) interleaved."""
+    n, L = labels.shape
+    ext = jnp.full((n, 2 * L + 1), 0, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    return ext
+
+
+@register("_ctc_loss", aliases=("ctc_loss", "CTCLoss"))
+def ctc_loss(pred, label, pred_lengths=None, label_lengths=None):
+    """pred: (T, N, C) raw activations; label: (N, L) int32, 0 = blank padding.
+
+    Returns per-example negative log likelihood, shape (N,).
+    """
+    T, N, C = pred.shape
+    logp = jax.nn.log_softmax(pred, axis=-1)
+    if label_lengths is None:
+        # labels padded with 0 (blank): length = count of non-zero entries
+        label_len = jnp.sum((label != 0).astype(jnp.int32), axis=1)
+    else:
+        label_len = label_lengths.astype(jnp.int32)
+    if pred_lengths is None:
+        pred_len = jnp.full((N,), T, dtype=jnp.int32)
+    else:
+        pred_len = pred_lengths.astype(jnp.int32)
+
+    ext = _interleave_blanks(label.astype(jnp.int32))  # (N, S) S = 2L+1
+    S = ext.shape[1]
+    ext_len = 2 * label_len + 1
+
+    # allow-transition mask: alpha[s] can come from s, s-1, and s-2 when
+    # ext[s] != blank and ext[s] != ext[s-2]
+    same_as_two_back = jnp.concatenate(
+        [jnp.zeros((N, 2), dtype=bool), ext[:, 2:] == ext[:, :-2]], axis=1
+    )
+    can_skip = (ext != 0) & (~same_as_two_back)
+
+    # initial alpha: positions 0 (blank) and 1 (first label)
+    init = jnp.full((N, S), _NEG_INF)
+    init = init.at[:, 0].set(logp[0, jnp.arange(N), ext[:, 0]])
+    init = init.at[:, 1].set(
+        jnp.where(S > 1, logp[0, jnp.arange(N), ext[:, 1]], _NEG_INF)
+    )
+
+    def step(alpha, t):
+        prev1 = jnp.concatenate([jnp.full((N, 1), _NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate([jnp.full((N, 2), _NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, _NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new_alpha = merged + emit
+        # freeze once past this example's input length
+        new_alpha = jnp.where((t < pred_len)[:, None], new_alpha, alpha)
+        return new_alpha, None
+
+    alpha, _ = lax.scan(step, init, jnp.arange(1, T))
+
+    idx = jnp.arange(N)
+    last = jnp.take_along_axis(alpha, (ext_len - 1)[:, None], axis=1)[:, 0]
+    second_last = jnp.take_along_axis(
+        alpha, jnp.maximum(ext_len - 2, 0)[:, None], axis=1
+    )[:, 0]
+    ll = jnp.logaddexp(last, second_last)
+    return -ll

@@ -1,0 +1,83 @@
+"""Imperative op dispatch + tape recording.
+
+Reference call stack being replaced (SURVEY.md §3.1):
+``_imperative_invoke -> MXImperativeInvokeEx -> Imperative::Invoke ->
+Engine::PushAsync -> FCompute kernel``.
+
+TPU-native: one Python hop. Arrays are unwrapped, the cached XLA executable
+for (op, attrs) runs asynchronously (JAX dispatch ≈ the dependency engine:
+results are futures; the Python thread does not block), and outputs are
+wrapped back into NDArrays. When ``autograd.record()`` is active and any
+input is tracked, the op is computed through ``jax.vjp`` and a TapeNode is
+linked (reference: ``Imperative::RecordOp``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .. import autograd
+from .registry import OpDef, jitted
+
+
+def _unwrap(x):
+    from ..ndarray.ndarray import NDArray
+
+    return x.data if isinstance(x, NDArray) else x
+
+
+def apply_op(opdef: OpDef, args, kwargs, out=None):
+    """Execute a registered op on NDArray/scalar args. Returns NDArray(s)."""
+    from ..ndarray.ndarray import NDArray, _wrap_result
+
+    raw = [_unwrap(a) for a in args]
+    ctx = None
+    for a in args:
+        if isinstance(a, NDArray):
+            ctx = a.ctx
+            break
+
+    if autograd.is_recording():
+        tracked_idx = [
+            i
+            for i, a in enumerate(args)
+            if isinstance(a, NDArray) and autograd.is_tracked(a)
+        ]
+        if tracked_idx:
+            return _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out)
+
+    res = jitted(opdef, kwargs)(*raw)
+    return _wrap_result(res, ctx, out)
+
+
+def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
+    from ..ndarray.ndarray import NDArray, _wrap_result
+
+    fn = jitted(opdef, kwargs)
+    tracked_raw = [raw[i] for i in tracked_idx]
+
+    def f(*t):
+        full = list(raw)
+        for i, v in zip(tracked_idx, t):
+            full[i] = v
+        return fn(*full)
+
+    res, vjp_fn = jax.vjp(f, *tracked_raw)
+    result = _wrap_result(res, ctx, out)
+    outs = result if isinstance(result, (list, tuple)) else [result]
+
+    node = autograd.TapeNode(
+        vjp_fn, [args[i] for i in tracked_idx], len(outs), name=opdef.name
+    )
+    node.out_arrays = list(outs)
+    for k, o in enumerate(outs):
+        o._ag = (node, k)
+    return result
+
+
+def invoke(name, *args, **kwargs):
+    """Invoke an op by registry name (testing/debug helper)."""
+    from .registry import get
+
+    out = kwargs.pop("out", None)
+    return apply_op(get(name), args, kwargs, out=out)

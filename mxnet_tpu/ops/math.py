@@ -1,0 +1,331 @@
+"""Elementwise, broadcast, comparison and reduction ops.
+
+Reference surface: ``src/operator/tensor/elemwise_*`` ,
+``broadcast_reduce_op_*`` (symbols ``broadcast_add``, ``sum``, ``norm`` ...).
+All are thin MXNet-semantics shims over jnp/lax; XLA fuses chains of these
+into single kernels (the reference needed an RTC pointwise-fusion pass for
+that — SURVEY.md §2.1 'Pointwise fusion' — here it is free).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# --------------------------------------------------------------------------
+# binary broadcast (MXNet: broadcast_* family; dispatch also routes
+# elemwise_add/_plus_scalar etc. here — jnp broadcasting is a superset)
+# --------------------------------------------------------------------------
+
+_BINARY = {
+    "broadcast_add": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_logical_and": lambda a, b: (jnp.logical_and(a, b)).astype(jnp.result_type(a, b)),
+    "broadcast_logical_or": lambda a, b: (jnp.logical_or(a, b)).astype(jnp.result_type(a, b)),
+    "broadcast_logical_xor": lambda a, b: (jnp.logical_xor(a, b)).astype(jnp.result_type(a, b)),
+    "arctan2": jnp.arctan2,
+}
+
+_BINARY_ALIASES = {
+    "broadcast_add": ("elemwise_add", "add", "_plus", "_add"),
+    "broadcast_sub": ("elemwise_sub", "subtract", "_minus", "_sub"),
+    "broadcast_mul": ("elemwise_mul", "multiply", "_mul"),
+    "broadcast_div": ("elemwise_div", "divide", "_div"),
+    "broadcast_mod": ("_mod",),
+    "broadcast_power": ("_power", "pow"),
+    "broadcast_maximum": ("maximum", "_maximum"),
+    "broadcast_minimum": ("minimum", "_minimum"),
+}
+
+for _name, _fn in _BINARY.items():
+
+    def _mk(fn):
+        def op(lhs, rhs):
+            return fn(lhs, rhs)
+
+        return op
+
+    register(_name, aliases=_BINARY_ALIASES.get(_name, ()))(_mk(_fn))
+
+_COMPARE = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+}
+
+for _name, _fn in _COMPARE.items():
+
+    def _mkc(fn):
+        def op(lhs, rhs):
+            # MXNet comparisons return the input float dtype (1.0/0.0)
+            return fn(lhs, rhs).astype(
+                jnp.result_type(lhs, rhs)
+                if jnp.issubdtype(jnp.result_type(lhs, rhs), jnp.floating)
+                else jnp.float32
+            )
+
+        return op
+
+    register(_name, aliases=(_name.replace("broadcast_", ""),))(_mkc(_fn))
+
+
+# --------------------------------------------------------------------------
+# unary
+# --------------------------------------------------------------------------
+
+import jax.scipy.special as jsp
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.fix,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sigmoid": lambda x: jax_sigmoid(x),
+    "softsign": lambda x: x / (1.0 + jnp.abs(x)),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+    "gammaln": jsp.gammaln,
+    "erf": jsp.erf,
+    "erfinv": jsp.erfinv,
+    "reciprocal": jnp.reciprocal,
+    "negative": jnp.negative,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype if jnp.issubdtype(x.dtype, jnp.floating) else jnp.float32),
+    "isnan": lambda x: jnp.isnan(x).astype(jnp.float32),
+    "isinf": lambda x: jnp.isinf(x).astype(jnp.float32),
+    "isfinite": lambda x: jnp.isfinite(x).astype(jnp.float32),
+}
+
+
+def jax_sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+import jax
+
+for _name, _fn in _UNARY.items():
+
+    def _mku(fn):
+        def op(data):
+            return fn(data)
+
+        return op
+
+    register(_name)(_mku(_fn))
+
+
+@register("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register("cast", aliases=("Cast", "astype"))
+def cast(data, dtype="float32"):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(
+        jnp.abs(data) < 1.0 / s2, 0.5 * s2 * data * data, jnp.abs(data) - 0.5 / s2
+    )
+
+
+# --------------------------------------------------------------------------
+# reductions (MXNet axis semantics: axis=None → all, `exclude` inverts)
+# --------------------------------------------------------------------------
+
+
+def _axes(axis, exclude, ndim):
+    if axis is None or axis == ():
+        ax = tuple(range(ndim))
+        return tuple(set(range(ndim)) - set(ax)) if exclude else ax
+    if isinstance(axis, int):
+        axis = (axis,)
+    ax = tuple(a % ndim for a in axis)
+    if exclude:
+        ax = tuple(i for i in range(ndim) if i not in ax)
+    return ax
+
+
+def _mkreduce(jfn):
+    def op(data, axis=None, keepdims=False, exclude=False):
+        return jfn(data, axis=_axes(axis, exclude, data.ndim), keepdims=keepdims)
+
+    return op
+
+
+for _name, _jfn, _aliases in (
+    ("sum", jnp.sum, ("sum_axis",)),
+    ("nansum", jnp.nansum, ()),
+    ("mean", jnp.mean, ()),
+    ("prod", jnp.prod, ()),
+    ("nanprod", jnp.nanprod, ()),
+    ("max", jnp.max, ("max_axis",)),
+    ("min", jnp.min, ("min_axis",)),
+):
+    register(_name, aliases=_aliases)(_mkreduce(_jfn))
+
+
+@register("norm")
+def norm(data, ord=2, axis=None, keepdims=False):
+    ax = axis if axis is not None else tuple(range(data.ndim))
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def argmax(data, axis=None, keepdims=False):
+    r = jnp.argmax(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+    return r
+
+
+@register("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+@register("topk")
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    x = data if not is_ascend else -data
+    x = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(x, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(jnp.dtype(dtype)))
+    if ret_typ == "mask":
+        m = jnp.zeros_like(jnp.moveaxis(data, axis, -1))
+        m = m.at[..., :].set(0)
+        oh = jnp.sum(jax.nn.one_hot(jnp.moveaxis(idx, axis, -1), data.shape[axis]), axis=-2)
+        return jnp.moveaxis(oh, -1, axis).astype(data.dtype)
+    return idx.astype(jnp.dtype(dtype))
+
+
+@register("sort")
+def sort(data, axis=-1, is_ascend=True):
+    r = jnp.sort(data, axis=axis)
+    return r if is_ascend else jnp.flip(r, axis=axis)
+
+
+@register("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    r = jnp.argsort(data, axis=axis, stable=True)
+    if not is_ascend:
+        r = jnp.flip(r, axis=axis)
+    return r.astype(jnp.dtype(dtype))
+
+
+@register("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# linalg-ish (reference: src/operator/tensor/dot*, la_op)
+# --------------------------------------------------------------------------
+
+
+@register("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    # MXNet dot: contract last axis of a with first axis of b;
+    # transpose flags reverse ALL axes of the operand (reference doc).
+    a = jnp.transpose(lhs) if transpose_a else lhs
+    b = jnp.transpose(rhs) if transpose_b else rhs
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    a = jnp.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return jnp.matmul(a, b)
+
+
+@register("matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register("khatri_rao")
+def khatri_rao(*args):
+    out = args[0]
+    for m in args[1:]:
+        out = jnp.einsum("i...,j...->ij...", out, m).reshape(-1, out.shape[-1])
+    return out
+
+
+@register("linalg_gemm2")
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_gemm")
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_potrf")
+def linalg_potrf(A):
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_syrk")
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose else A
+    return alpha * jnp.matmul(a, jnp.swapaxes(a, -1, -2))

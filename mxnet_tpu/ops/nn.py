@@ -1,0 +1,468 @@
+"""Neural-network ops: the MXU-facing surface.
+
+Reference surface: ``src/operator/nn/`` (symbols ``Convolution``,
+``FullyConnected``, ``BatchNorm``, ``Pooling``, ``Activation``,
+``Dropout``, ``LayerNorm`` ...). TPU-native notes:
+
+- Conv/FC lower to ``lax.conv_general_dilated`` / ``lax.dot_general`` —
+  XLA tiles these onto the MXU; there is no cuDNN algo selection to port.
+- BatchNorm is pure: training mode returns (out, new_moving_mean,
+  new_moving_var); the Gluon layer writes the stats back into its aux
+  parameters (works eagerly and under CachedOp functionalized tracing).
+- Dropout draws from :mod:`mxnet_tpu.ndarray.random`'s key stream so it is
+  reproducible under ``mx.random.seed`` and traceable under hybridize.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+@register("FullyConnected", aliases=("fully_connected",))
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True):
+    x = data.reshape((data.shape[0], -1)) if flatten else data
+    y = jnp.matmul(x, weight.T) if x.ndim == 2 else jnp.einsum("...i,oi->...o", x, weight)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+_CONV_DN = {
+    1: ("NCW", "OIW", "NCW"),
+    2: ("NCHW", "OIHW", "NCHW"),
+    3: ("NCDHW", "OIDHW", "NCDHW"),
+}
+
+
+@register("Convolution", aliases=("convolution",))
+def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=(),
+                num_filter=0, num_group=1, no_bias=False, layout=None,
+                workspace=0, cudnn_tune=None, cudnn_off=False):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
+    y = lax.conv_general_dilated(
+        data,
+        weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register("Deconvolution", aliases=("deconvolution",))
+def deconvolution(data, weight, bias=None, kernel=(), stride=(), dilate=(),
+                  pad=(), adj=(), target_shape=(), num_filter=0, num_group=1,
+                  no_bias=True, layout=None, workspace=0, cudnn_tune=None,
+                  cudnn_off=False):
+    nd = len(kernel)
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    adj = adj or (0,) * nd
+    # transposed conv == gradient of conv wrt input: use conv_general_dilated
+    # with lhs_dilation=stride and flipped spatial padding.
+    pads = []
+    for i in range(nd):
+        k = (kernel[i] - 1) * dilate[i]
+        pads.append((k - pad[i], k - pad[i] + adj[i]))
+    if num_group > 1:
+        # weight layout (Cin, Cout/g, *k): split into groups
+        xs = jnp.split(data, num_group, axis=1)
+        ws = jnp.split(weight, num_group, axis=0)
+        outs = [_deconv_one(x, w, stride, dilate, pads, nd) for x, w in zip(xs, ws)]
+        y = jnp.concatenate(outs, axis=1)
+    else:
+        y = _deconv_one(data, weight, stride, dilate, pads, nd)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+def _deconv_one(data, weight, stride, dilate, pads, nd):
+    # weight (Cin, Cout, *k) -> conv kernel (Cout, Cin, *k) flipped
+    w = jnp.swapaxes(weight, 0, 1)
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _CONV_DN[nd])
+    return lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+    )
+
+
+@register("Pooling", aliases=("pooling",))
+def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
+            pad=(), pooling_convention="valid", count_include_pad=True,
+            cudnn_off=False, p_value=2, layout=None):
+    nd = data.ndim - 2
+    if global_pool:
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: add extra right-padding so the last window fits
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra.append((stride[i] - rem) % stride[i] if size > kernel[i] else 0)
+        pads = ((0, 0), (0, 0)) + tuple(
+            (pad[i], pad[i] + extra[i]) for i in range(nd)
+        )
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones_like(data)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.abs(data) ** p_value, 0.0, lax.add, window, strides, pads)
+        return s ** (1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+@register("Activation", aliases=("activation",))
+def activation(data, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(data, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return data / (1 + jnp.abs(data))
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("LeakyReLU")
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma.reshape((1, -1) + (1,) * (data.ndim - 2)) if gamma.ndim == 1 and data.ndim > 2 else gamma
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":  # eval mode: mean slope
+        return jnp.where(data >= 0, data, (lower_bound + upper_bound) / 2 * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register("softmax", aliases=("Softmax", "SoftmaxActivation"))
+def softmax(data, axis=-1, temperature=None, length=None, use_length=False,
+            dtype=None):
+    x = data / temperature if temperature not in (None, 1.0) else data
+    if use_length and length is not None:
+        steps = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = steps.reshape(shape) < jnp.expand_dims(length, axis)
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register("log_softmax")
+def log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature not in (None, 1.0) else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register("softmin")
+def softmin(data, axis=-1, temperature=None, dtype=None):
+    return softmax(-data, axis=axis, temperature=temperature)
+
+
+@register("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    lsm = jax.nn.log_softmax(data, axis=-1)
+    oh = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return -jnp.sum(lsm * oh)
+
+
+@register("SoftmaxOutput", aliases=("softmax_output",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0):
+    """Legacy Module-era loss layer: forward = softmax; the CE gradient is
+    injected via custom VJP (reference: ``softmax_output-inl.h``)."""
+    return _softmax_output_vjp(data, label, grad_scale, ignore_label, use_ignore,
+                               normalization)
+
+
+@jax.custom_vjp
+def _softmax_output_vjp(data, label, grad_scale, ignore_label, use_ignore, norm):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, norm):
+    p = jax.nn.softmax(data, axis=-1)
+    return p, (p, label, grad_scale, ignore_label, use_ignore, norm)
+
+
+def _so_bwd(res, g):
+    p, label, grad_scale, ignore_label, use_ignore, norm = res
+    oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+    grad = p - oh
+    if use_ignore:
+        keep = (label != ignore_label).astype(p.dtype)
+        grad = grad * keep[..., None]
+    if norm == "batch":
+        grad = grad / p.shape[0]
+    elif norm == "valid" and use_ignore:
+        keep = (label != ignore_label).astype(p.dtype)
+        grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
+    return (grad * grad_scale, None, None, None, None, None)
+
+
+_softmax_output_vjp.defvjp(_so_fwd, _so_bwd)
+
+
+@register("BatchNorm", aliases=("batch_norm",))
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=False, training=False):
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    if training and not use_global_stats:
+        red = tuple(i for i in range(data.ndim) if i != axis)
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+        new_mean = momentum * moving_mean + (1 - momentum) * mean
+        new_var = momentum * moving_var + (1 - momentum) * var
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var + eps).astype(data.dtype)
+    out = (data - mean.reshape(shape).astype(data.dtype)) * inv.reshape(shape) \
+        * g.reshape(shape).astype(data.dtype) + beta.reshape(shape).astype(data.dtype)
+    if training and not use_global_stats:
+        return out, new_mean, new_var
+    if output_mean_var:
+        return out, mean, var
+    return out
+
+
+@register("LayerNorm", aliases=("layer_norm",))
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = out * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+    return out
+
+
+@register("InstanceNorm")
+def instance_norm(data, gamma, beta, eps=1e-3):
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    out = (data - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("GroupNorm")
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape((n, num_groups, c // num_groups) + rest)
+    red = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=red, keepdims=True)
+    var = jnp.var(x, axis=red, keepdims=True)
+    x = (x - mean) * lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return x * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    elif mode == "channel":
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=1, keepdims=True) + eps)
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        n = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=True) + eps)
+    return data / n
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    sq = jnp.square(data)
+    half = nsize // 2
+    padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+    acc = sum(padded[:, i:i + data.shape[1]] for i in range(nsize))
+    return data / jnp.power(knorm + alpha / nsize * acc, beta)
+
+
+@register("Dropout", aliases=("dropout",))
+def dropout_op(data, key, p=0.5, mode="training", axes=(), cudnn_off=False):
+    if p <= 0.0:
+        return data
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape)).astype(data.dtype)
+    return data * mask / keep
+
+
+@register("identity_with_attr_like_rhs")
+def identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("RNN")
+def rnn_fused(data, params, state, state_cell=None, key=None, state_size=0,
+              num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+              state_outputs=True, projection_size=None,
+              lstm_state_clip_min=None, lstm_state_clip_max=None,
+              lstm_state_clip_nan=False, use_sequence_length=False):
+    """Fused multi-layer RNN (reference: ``src/operator/rnn.cc``).
+
+    TPU-native: each layer is a ``lax.scan`` over time; weights are sliced
+    out of the flat ``params`` vector using cuDNN's canonical packing order
+    (the order the reference uses, so zoo checkpoints load unchanged).
+    Layout: seq-major ``data (T, N, C)``, ``state (L*D, N, H)``.
+    """
+    T, N, C = data.shape
+    H = state_size
+    D = 2 if bidirectional else 1
+    ngates = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}[mode]
+
+    offset = 0
+
+    def take_mat(rows, cols):
+        nonlocal offset
+        w = lax.dynamic_slice(params, (offset,), (rows * cols,)).reshape(rows, cols)
+        offset += rows * cols
+        return w
+
+    # collect per-layer weights (cuDNN order: all Wx, Wh per layer/direction
+    # first, then all biases)
+    layer_w = []
+    for layer in range(num_layers):
+        for d in range(D):
+            in_c = C if layer == 0 else H * D
+            wx = take_mat(ngates * H, in_c)
+            wh = take_mat(ngates * H, H)
+            layer_w.append((wx, wh))
+    layer_b = []
+    for layer in range(num_layers):
+        for d in range(D):
+            bx = lax.dynamic_slice(params, (offset,), (ngates * H,))
+            offset += ngates * H
+            bh = lax.dynamic_slice(params, (offset,), (ngates * H,))
+            offset += ngates * H
+            layer_b.append((bx, bh))
+
+    def cell_step(mode):
+        def lstm(carry, xw, wh, bh):
+            h, c = carry
+            gates = xw + jnp.matmul(h, wh.T) + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        def gru(carry, xw, wh, bh):
+            (h,) = carry
+            xr, xz, xn = jnp.split(xw, 3, axis=-1)
+            hr, hz, hn = jnp.split(jnp.matmul(h, wh.T) + bh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h2 = (1 - z) * n + z * h
+            return (h2,), h2
+
+        def vanilla(carry, xw, wh, bh, act):
+            (h,) = carry
+            h2 = act(xw + jnp.matmul(h, wh.T) + bh)
+            return (h2,), h2
+
+        if mode == "lstm":
+            return lstm
+        if mode == "gru":
+            return gru
+        if mode == "rnn_tanh":
+            return lambda c, xw, wh, bh: vanilla(c, xw, wh, bh, jnp.tanh)
+        return lambda c, xw, wh, bh: vanilla(c, xw, wh, bh, lambda v: jnp.maximum(v, 0))
+
+    step = cell_step(mode)
+    x = data
+    h_states, c_states = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(D):
+            li = layer * D + d
+            wx, wh = layer_w[li]
+            bx, bh = layer_b[li]
+            h0 = state[li]
+            carry = (h0, state_cell[li]) if mode == "lstm" else (h0,)
+            seq = x if d == 0 else jnp.flip(x, axis=0)
+            xw = jnp.einsum("tnc,gc->tng", seq, wx) + bx
+
+            def scan_fn(carry, xw_t, wh=wh, bh=bh):
+                return step(carry, xw_t, wh, bh)
+
+            carry, ys = lax.scan(scan_fn, carry, xw)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            outs.append(ys)
+            h_states.append(carry[0])
+            if mode == "lstm":
+                c_states.append(carry[1])
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and layer < num_layers - 1 and key is not None:
+            sub = jax.random.fold_in(key, layer)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape).astype(x.dtype)
+            x = x * mask / (1 - p)
+    hN = jnp.stack(h_states, axis=0)
+    if mode == "lstm":
+        return x, hN, jnp.stack(c_states, axis=0)
+    return x, hN

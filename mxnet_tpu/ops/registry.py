@@ -1,0 +1,71 @@
+"""Operator registry.
+
+Reference: the nnvm op registry (``NNVM_REGISTER_OP`` in ``src/operator/**``)
+plus the import-time Python stub generation (``python/mxnet/ndarray/register.py``).
+
+TPU-native design: an op is a pure JAX function ``fn(*arrays, **attrs)``.
+Attrs are static (hashable) by construction; a jitted executable is cached
+per (op, attrs) combination — this is the imperative fast path, the analog
+of the reference's FCompute kernel cache. The same registry drives the
+``nd.*`` namespace, NDArray methods, and the lazy ``sym.*`` namespace.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_OPS: dict[str, "OpDef"] = {}
+
+
+class OpDef:
+    __slots__ = ("fn", "name", "aliases", "wrap_out", "as_method")
+
+    def __init__(self, fn, name, aliases=(), as_method=None):
+        self.fn = fn
+        self.name = name
+        self.aliases = aliases
+        self.as_method = as_method  # attach to NDArray under this name
+
+    def __repr__(self):
+        return f"<op {self.name}>"
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        return self is other
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(opdef: OpDef, kw_items: tuple):
+    kwargs = dict(kw_items)
+    return jax.jit(lambda *xs: opdef.fn(*xs, **kwargs))
+
+
+def jitted(opdef: OpDef, kwargs: dict):
+    """Cached XLA executable for this op + static attrs."""
+    return _jitted(opdef, tuple(sorted(kwargs.items())))
+
+
+def register(name=None, aliases=(), as_method=None):
+    """Register an op implementation. ``fn(*arrays, **static_attrs)``."""
+
+    def deco(fn):
+        opname = name or fn.__name__
+        opdef = OpDef(fn, opname, tuple(aliases), as_method)
+        _OPS[opname] = opdef
+        for a in aliases:
+            _OPS[a] = opdef
+        return fn
+
+    return deco
+
+
+def get(name: str) -> OpDef:
+    return _OPS[name]
+
+
+def all_ops() -> dict:
+    return _OPS

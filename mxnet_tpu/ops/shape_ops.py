@@ -1,0 +1,359 @@
+"""Shape-manipulation and indexing ops.
+
+Reference surface: ``src/operator/tensor/matrix_op*`` (reshape/transpose/
+slice/concat/...), ``indexing_op*`` (take/one_hot/gather_nd/Embedding).
+MXNet reshape magic codes (0, -1, -2, -3, -4) are implemented in full.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _infer_reshape(src_shape, target):
+    """MXNet reshape special values (reference: matrix_op ``ReshapeParam``):
+    0 copy input dim; -1 infer; -2 copy all remaining; -3 merge next two
+    input dims; -4 split an input dim by the following two target values."""
+    out = []
+    src = list(src_shape)
+    i = 0  # index into src
+    t = 0
+    target = list(target)
+    while t < len(target):
+        d = target[t]
+        if d == 0:
+            out.append(src[i])
+            i += 1
+        elif d == -1:
+            out.append(-1)
+            i += 1
+        elif d == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif d == -4:
+            d1, d2 = target[t + 1], target[t + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2])
+            t += 2
+            i += 1
+        else:
+            out.append(d)
+            i += 1
+        t += 1
+    # resolve a single -1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src_shape:
+            total *= d
+        out[out.index(-1)] = total // known if known else 0
+    return tuple(out)
+
+
+@register("reshape", aliases=("Reshape",))
+def reshape(data, shape=None, reverse=False):
+    shape = tuple(shape)
+    if reverse:
+        rs = _infer_reshape(data.shape[::-1], tuple(reversed(shape)))
+        return jnp.reshape(data, rs[::-1])
+    return jnp.reshape(data, _infer_reshape(data.shape, shape))
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register("flatten", aliases=("Flatten",))
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, axes if axes else None)
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def swapaxes(data, dim1=0, dim2=0):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register("expand_dims")
+def expand_dims(data, axis=0):
+    return jnp.expand_dims(data, axis)
+
+
+@register("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register("concat", aliases=("Concat",))
+def concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("split", aliases=("SliceChannel",))
+def split(data, num_outputs=1, axis=1, squeeze_axis=False):
+    parts = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num_outputs > 1 else parts[0]
+
+
+@register("split_v2")
+def split_v2(data, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections:
+        parts = jnp.split(data, sections, axis=axis)
+    else:
+        parts = jnp.split(data, list(indices), axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("slice", aliases=("crop",))
+def slice_op(data, begin=(), end=(), step=()):
+    idx = []
+    for i in range(len(begin)):
+        st = step[i] if step and i < len(step) and step[i] is not None else 1
+        idx.append(slice(begin[i], end[i], st))
+    return data[tuple(idx)]
+
+
+@register("_slice_basic")
+def _slice_basic(data, index=None):
+    from ..ndarray.ndarray import _thaw_index
+
+    return data[_thaw_index(index)]
+
+
+@register("slice_axis")
+def slice_axis(data, axis=0, begin=0, end=None):
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register("slice_like")
+def slice_like(data, shape_like, axes=()):
+    axes = axes or range(data.ndim)
+    idx = [slice(None)] * data.ndim
+    for a in axes:
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    else:
+        idx = jnp.clip(idx, 0, n - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register("pick")
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    r = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return r if keepdims else jnp.squeeze(r, axis=axis)
+
+
+@register("Embedding", aliases=("embedding",))
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register("one_hot")
+def one_hot(indices, depth=None, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=jnp.dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register("gather_nd")
+def gather_nd(data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register("scatter_nd")
+def scatter_nd(data, indices, shape=None):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register("tile")
+def tile(data, reps=()):
+    return jnp.tile(data, reps)
+
+
+@register("repeat")
+def repeat(data, repeats=1, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    return jnp.pad(data, pw, mode="edge" if mode == "edge" else "reflect")
+
+
+@register("flip", aliases=("reverse",))
+def flip(data, axis=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(data, axis=axis)
+
+
+@register("broadcast_to")
+def broadcast_to(data, shape=()):
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_like")
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    if lhs_axes is None:
+        return jnp.broadcast_to(lhs, rhs.shape)
+    tgt = list(lhs.shape)
+    for la, ra in zip(lhs_axes, rhs_axes):
+        tgt[la] = rhs.shape[ra]
+    return jnp.broadcast_to(lhs, tuple(tgt))
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def broadcast_axis(data, axis=(), size=()):
+    if isinstance(axis, int):
+        axis, size = (axis,), (size,)
+    tgt = list(data.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("zeros_like")
+def zeros_like(data):
+    return jnp.zeros_like(data)
+
+
+@register("ones_like")
+def ones_like(data):
+    return jnp.ones_like(data)
+
+
+@register("full_like")
+def full_like(data, fill_value=0.0):
+    return jnp.full_like(data, fill_value)
+
+
+@register("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if False else jnp.int32)
+
+
+@register("size_array")
+def size_array(data):
+    s = 1
+    for d in data.shape:
+        s *= d
+    return jnp.asarray([s], dtype=jnp.int32)
+
+
+@register("diag")
+def diag(data, k=0):
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=-2, axis2=-1)
+
+
+@register("identity", aliases=("_copy", "copy"))
+def identity(data):
+    return data + 0  # new buffer, same values
+
+
+@register("stop_gradient", aliases=("BlockGrad", "make_loss", "MakeLoss"))
+def stop_gradient(data):
+    return jax.lax.stop_gradient(data)
+
+
+@register("boolean_mask")
+def boolean_mask(data, index, axis=0):
+    # dynamic-shape op: TPU-native contract returns padded data + valid count
+    # is handled at contrib level; eager path materializes on host semantics
+    mask = index.astype(bool)
+    return jnp.compress(mask, data, axis=axis)
+
+
+@register("sequence_mask", aliases=("SequenceMask",))
+def sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0,
+                  axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    mask = steps[:, None] < sequence_length[None, :]  # (T, B)
+    if axis == 1:
+        mask = mask.T
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    batch_axis = 1 - axis
+    shape[batch_axis] = data.shape[batch_axis]
+    mask = mask.reshape(shape)
+    return jnp.where(mask, data, value)
+
+
+@register("SequenceLast")
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0
+    )[0]
+
+
+@register("SequenceReverse")
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)
+    rev = jnp.take_along_axis(moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(rev, 0, axis)

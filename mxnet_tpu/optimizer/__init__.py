@@ -1,0 +1,23 @@
+"""``mx.optimizer`` (reference: ``python/mxnet/optimizer/``)."""
+
+from .optimizer import (  # noqa: F401
+    Optimizer,
+    SGD,
+    NAG,
+    Signum,
+    Adam,
+    AdamW,
+    AdaGrad,
+    AdaDelta,
+    RMSProp,
+    Ftrl,
+    FTML,
+    LARS,
+    LAMB,
+    DCASGD,
+    SGLD,
+    Updater,
+    get_updater,
+    create,
+    register,
+)

@@ -1,0 +1,548 @@
+"""Optimizers.
+
+Reference: ``python/mxnet/optimizer/optimizer.py`` + the fused update kernels
+in ``src/operator/optimizer_op*`` (symbols ``sgd_update``, ``adam_update``,
+``mp_sgd_update``, ``multi_sgd``...).
+
+TPU-native: each update rule is one jitted XLA function taking (weight, grad,
+*state, lr, wd) as device arrays — the analog of the reference's fused
+kernels, with multi-precision (fp32 master weights) supported the same way.
+Scalars (lr/wd) are passed as arrays to avoid retracing per step.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+@functools.lru_cache(maxsize=None)
+def _jit(fn, static_items):
+    kw = dict(static_items)
+    return jax.jit(lambda *a: fn(*a, **kw))
+
+
+class Optimizer:
+    """Base optimizer (reference: ``Optimizer.create_optimizer`` registry)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None, **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- registry ---------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        return register(klass)
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() not in _OPT_REGISTRY:
+            raise MXNetError(f"unknown optimizer {name}")
+        return _OPT_REGISTRY[name.lower()](**kwargs)
+
+    # -- lr/wd ------------------------------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set learning_rate")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            master = NDArray(weight.data.astype(jnp.float32), ctx=weight.ctx)
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- update -----------------------------------------------------------
+    def _preprocess(self, grad):
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            master, st = state
+            g32 = NDArray(grad.data.astype(jnp.float32), ctx=grad.ctx)
+            self.update(index, master, g32, st)
+            weight._set_data(master.data.astype(jnp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference kernels: ``sgd_update``/``sgd_mom_update``).
+
+    state = momentum buffer; update matches the reference formula:
+    ``mom = momentum*mom - lr*(grad + wd*weight); weight += mom``.
+    """
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad)
+        w = weight.data
+        if state is None:
+            weight._set_data(w - lr * (g + wd * w.astype(g.dtype)).astype(w.dtype))
+        else:
+            mom = self.momentum * state.data - lr * (g + wd * w.astype(g.dtype))
+            state._set_data(mom)
+            weight._set_data(w + mom.astype(w.dtype))
+
+
+@register
+class NAG(SGD):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad) + wd * weight.data
+        w = weight.data
+        if state is None:
+            weight._set_data(w - lr * g)
+        else:
+            mom = self.momentum * state.data + g
+            state._set_data(mom)
+            weight._set_data(w - lr * (g + self.momentum * mom))
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad)
+        w = weight.data
+        if state is not None:
+            mom = self.momentum * state.data - (1 - self.momentum) * (g + wd * w)
+            state._set_data(mom)
+            weight._set_data((1 - lr * self.wd_lh) * w + lr * jnp.sign(mom))
+        else:
+            weight._set_data((1 - lr * self.wd_lh) * w - lr * jnp.sign(g + wd * w))
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference kernel: ``adam_update``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (NDArray(z, ctx=weight.ctx), NDArray(z, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        g = self._preprocess(grad) + wd * weight.data
+        m, v = state
+        m_t = self.beta1 * m.data + (1 - self.beta1) * g
+        v_t = self.beta2 * v.data + (1 - self.beta2) * jnp.square(g)
+        m._set_data(m_t)
+        v._set_data(v_t)
+        weight._set_data(weight.data - lr_t * m_t / (jnp.sqrt(v_t) + self.epsilon))
+
+
+@register
+class AdamW(Adam):
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * (1.0 - self.beta2 ** t) ** 0.5 / (1.0 - self.beta1 ** t)
+        g = self._preprocess(grad)
+        m, v = state
+        m_t = self.beta1 * m.data + (1 - self.beta1) * g
+        v_t = self.beta2 * v.data + (1 - self.beta2) * jnp.square(g)
+        m._set_data(m_t)
+        v._set_data(v_t)
+        weight._set_data(
+            weight.data - lr_t * m_t / (jnp.sqrt(v_t) + self.epsilon)
+            - lr * wd * weight.data
+        )
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype), ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad) + wd * weight.data
+        hist = state.data + jnp.square(g)
+        state._set_data(hist)
+        weight._set_data(weight.data - lr * g / (jnp.sqrt(hist) + self.float_stable_eps))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (NDArray(z, ctx=weight.ctx), NDArray(z, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = self._preprocess(grad) + wd * weight.data
+        acc_g, acc_delta = state
+        ag = self.rho * acc_g.data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta.data + self.epsilon) / jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta.data + (1 - self.rho) * jnp.square(delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight.data - delta)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        if self.centered:
+            return (NDArray(z, ctx=weight.ctx), NDArray(z, ctx=weight.ctx),
+                    NDArray(z, ctx=weight.ctx))
+        return (NDArray(z, ctx=weight.ctx),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad) + wd * weight.data
+        if not self.centered:
+            (n,) = state
+            nv = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
+            n._set_data(nv)
+            w = weight.data - lr * g / jnp.sqrt(nv + self.epsilon)
+        else:
+            n, gmean, delta = state
+            nv = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
+            gv = (1 - self.gamma1) * g + self.gamma1 * gmean.data
+            dv = self.gamma2 * delta.data - lr * g / jnp.sqrt(nv - jnp.square(gv) + self.epsilon)
+            n._set_data(nv)
+            gmean._set_data(gv)
+            delta._set_data(dv)
+            w = weight.data + dv
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        weight._set_data(w)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (NDArray(z, ctx=weight.ctx), NDArray(z, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad)
+        z, n = state
+        sigma = (jnp.sqrt(n.data + jnp.square(g)) - jnp.sqrt(n.data)) / lr
+        zv = z.data + g - sigma * weight.data
+        nv = n.data + jnp.square(g)
+        z._set_data(zv)
+        n._set_data(nv)
+        new_w = jnp.where(
+            jnp.abs(zv) <= self.lamda1,
+            jnp.zeros_like(zv),
+            -(zv - jnp.sign(zv) * self.lamda1)
+            / ((self.beta + jnp.sqrt(nv)) / lr + wd),
+        )
+        weight._set_data(new_w)
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return tuple(NDArray(z, ctx=weight.ctx) for _ in range(3))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess(grad) + wd * weight.data
+        d, v, zs = state
+        vv = self.beta2 * v.data + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** t) / lr * (
+            jnp.sqrt(vv / (1 - self.beta2 ** t)) + self.epsilon
+        )
+        sigma = d_t - self.beta1 * d.data
+        zv = self.beta1 * zs.data + (1 - self.beta1) * g - sigma * weight.data
+        v._set_data(vv)
+        d._set_data(d_t)
+        zs._set_data(zv)
+        weight._set_data(-zv / d_t)
+
+
+@register
+class LARS(SGD):
+    """Layer-wise adaptive rate scaling (reference: ``lars_*`` kernels)."""
+
+    def __init__(self, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad)
+        w = weight.data
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        ratio = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0,
+        )
+        lr_eff = lr * ratio
+        if state is None:
+            weight._set_data(w - lr_eff * (g + wd * w))
+        else:
+            mom = self.momentum * state.data - lr_eff * (g + wd * w)
+            state._set_data(mom)
+            weight._set_data(w + mom)
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (NDArray(z, ctx=weight.ctx), NDArray(z, ctx=weight.ctx))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = self._preprocess(grad)
+        m, v = state
+        m_t = self.beta1 * m.data + (1 - self.beta1) * g
+        v_t = self.beta2 * v.data + (1 - self.beta2) * jnp.square(g)
+        m._set_data(m_t)
+        v._set_data(v_t)
+        if self.bias_correction:
+            m_hat = m_t / (1 - self.beta1 ** t)
+            v_hat = v_t / (1 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = m_t, v_t
+        r = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight.data
+        w_norm = jnp.linalg.norm(weight.data)
+        r_norm = jnp.linalg.norm(r)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        weight._set_data(weight.data - lr * ratio * r)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        z = jnp.zeros(weight.shape, weight.data.dtype)
+        return (
+            None if self.momentum == 0.0 else NDArray(z, ctx=weight.ctx),
+            NDArray(weight.data, ctx=weight.ctx),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad)
+        mom, prev = state
+        delta = -lr * (
+            g + wd * weight.data
+            + self.lamda * g * g * (weight.data - prev.data)
+        )
+        if mom is not None:
+            delta = self.momentum * mom.data + delta
+            mom._set_data(delta)
+        prev._set_data(weight.data)
+        weight._set_data(weight.data + delta)
+
+
+@register
+class SGLD(Optimizer):
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess(grad) + wd * weight.data
+        noise = jax.random.normal(_random._next_key(), weight.shape,
+                                  weight.data.dtype) * jnp.sqrt(lr)
+        weight._set_data(weight.data - lr / 2 * g + noise)
+
+
+# Test/updater plumbing (reference: ``optimizer.py:get_updater``/``Updater``)
+
+
+class Updater:
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight
+            )
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        return pickle.dumps(self.states)
+
+    def set_states(self, states):
+        import pickle
+
+        self.states = pickle.loads(states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

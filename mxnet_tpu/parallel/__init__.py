@@ -1,0 +1,11 @@
+"""``mxnet_tpu.parallel`` — SPMD mesh parallelism.
+
+No reference counterpart: MXNet 1.x scales via KVStore push/pull (SURVEY.md
+§2.5). This package is the TPU-native replacement: a device Mesh +
+sharding-annotated fused train step. Data parallel ≈ batch-axis sharding
+(grads psum'd by XLA over ICI); tensor/ZeRO sharding are sharding
+annotations on the same step (P9/P13 in SURVEY.md §2.5).
+"""
+
+from .mesh import make_mesh, current_mesh, data_parallel_mesh  # noqa: F401
+from .spmd import SPMDTrainStep, shard_batch, replicate  # noqa: F401

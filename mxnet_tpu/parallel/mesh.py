@@ -1,0 +1,46 @@
+"""Device mesh helpers."""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh
+
+_CURRENT = [None]
+
+
+def make_mesh(axes=None, devices=None):
+    """Create a ``jax.sharding.Mesh``.
+
+    ``axes``: dict of axis name -> size, e.g. ``{"dp": 4, "tp": 2}``.
+    Sizes must multiply to the device count (-1 allowed once to infer).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    if axes is None:
+        axes = {"dp": n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    assert total == n, f"mesh {dict(zip(names, sizes))} != {n} devices"
+    dev_array = _np.array(devices).reshape(sizes)
+    mesh = Mesh(dev_array, tuple(names))
+    _CURRENT[0] = mesh
+    return mesh
+
+
+def data_parallel_mesh():
+    return make_mesh({"dp": len(jax.devices())})
+
+
+def current_mesh():
+    return _CURRENT[0]

@@ -1,0 +1,242 @@
+"""Fused SPMD train step over a device mesh.
+
+This is the TPU-native training fast path (SURVEY.md §7.4): the whole
+forward + backward + optimizer update compiles into ONE XLA executable with
+sharding annotations; gradients are psum'd by XLA over the mesh's ``dp``
+axis (replacing KVStore push/pull entirely). Tensor-parallel and
+ZeRO-style state sharding are expressed as alternative param shardings on
+the same step.
+
+Uses the same "functionalize the imperative frontend" trick as CachedOp:
+the Gluon block's Python forward runs once under tracing with parameter
+handles bound to tracers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .. import random as _random
+from ..base import MXNetError
+from ..gluon.block import _TRACE_STATE
+from ..ndarray.ndarray import NDArray
+
+
+def shard_batch(arr, mesh, axis_name="dp"):
+    """Place a host batch sharded along its leading axis."""
+    raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    sharding = NamedSharding(mesh, P(axis_name, *([None] * (raw.ndim - 1))))
+    return jax.device_put(raw, sharding)
+
+
+def replicate(arr, mesh):
+    raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    return jax.device_put(raw, NamedSharding(mesh, P()))
+
+
+def _sgd_rule(hyper):
+    mom = hyper.get("momentum", 0.0)
+    wd = hyper.get("wd", 0.0)
+
+    def init(w):
+        return (jnp.zeros_like(w),) if mom else ()
+
+    def update(w, g, state, lr):
+        g = g + wd * w
+        if mom:
+            m = mom * state[0] - lr * g
+            return w + m, (m,)
+        return w - lr * g, ()
+
+    return init, update
+
+
+def _adam_rule(hyper):
+    beta1 = hyper.get("beta1", 0.9)
+    beta2 = hyper.get("beta2", 0.999)
+    eps = hyper.get("epsilon", 1e-8)
+    wd = hyper.get("wd", 0.0)
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+
+    def update(w, g, state, lr):
+        m, v, t = state
+        t = t + 1
+        g = g + wd * w
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * jnp.square(g)
+        tf = t.astype(w.dtype)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+        return w - lr_t * m / (jnp.sqrt(v) + eps), (m, v, t)
+
+    return init, update
+
+
+def _lamb_rule(hyper):
+    beta1 = hyper.get("beta1", 0.9)
+    beta2 = hyper.get("beta2", 0.999)
+    eps = hyper.get("epsilon", 1e-6)
+    wd = hyper.get("wd", 0.0)
+
+    def init(w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+
+    def update(w, g, state, lr):
+        m, v, t = state
+        t = t + 1
+        m = beta1 * m + (1 - beta1) * g
+        v = beta2 * v + (1 - beta2) * jnp.square(g)
+        tf = t.astype(w.dtype)
+        m_hat = m / (1 - beta1 ** tf)
+        v_hat = v / (1 - beta2 ** tf)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return w - lr * ratio * r, (m, v, t)
+
+    return init, update
+
+
+_RULES = {"sgd": _sgd_rule, "nag": _sgd_rule, "adam": _adam_rule,
+          "adamw": _adam_rule, "lamb": _lamb_rule}
+
+
+class SPMDTrainStep:
+    """One-executable train step for a Gluon block over a mesh.
+
+    >>> step = SPMDTrainStep(net, loss_fn, "sgd", {"momentum": 0.9}, mesh)
+    >>> loss = step(batch_x, batch_y, lr=0.1)
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, batch_axis="dp", param_sharding=None,
+                 shard_opt_states=False, grad_dtype=None, donate=True):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        hyper = dict(optimizer_params or {})
+        if optimizer not in _RULES:
+            raise MXNetError(
+                f"SPMD step supports {sorted(_RULES)}; got {optimizer}. "
+                "Use gluon.Trainer for other optimizers.")
+        self._rule_init, self._rule_update = _RULES[optimizer](hyper)
+        self._param_sharding = param_sharding or {}
+        self._shard_opt_states = shard_opt_states
+        self._donate = donate
+        self._compiled = None
+        self._state = None  # (params, aux, opt_states) raw pytrees
+        self._names = None
+        self._diff = None
+
+    # -- state management -------------------------------------------------
+    def _collect(self):
+        items = sorted(self.block.collect_params().items())
+        names = [n for n, _ in items]
+        handles = [p.data() for _, p in items]
+        diff = [p.grad_req != "null" for _, p in items]
+        return names, handles, diff
+
+    def _sharding_for(self, name, raw):
+        if self.mesh is None:
+            return None
+        spec = self._param_sharding.get(name, P())
+        return NamedSharding(self.mesh, spec)
+
+    def init_state(self):
+        names, handles, diff = self._collect()
+        self._names, self._handles, self._diff = names, handles, diff
+        params = []
+        opt_states = []
+        for n, h, d in zip(names, handles, diff):
+            raw = h.data
+            if self.mesh is not None:
+                raw = jax.device_put(raw, self._sharding_for(n, raw))
+            params.append(raw)
+            opt_states.append(self._rule_init(raw) if d else ())
+        self._state = (params, opt_states)
+
+    # -- compiled step ----------------------------------------------------
+    def _build(self, x_shape_dtype, y_shape_dtype):
+        block, loss_fn = self.block, self.loss_fn
+        handles, diff = self._handles, self._diff
+        rule_update = self._rule_update
+
+        def run_forward(param_raws, x, y, key):
+            _TRACE_STATE.active = True
+            _random.push_trace_key(key)
+            saved = [h._data_ for h in handles]
+            try:
+                for h, raw in zip(handles, param_raws):
+                    h._data_ = raw
+                xin = NDArray(x)
+                yin = NDArray(y)
+                with autograd._RecordingStateScope(False, True):
+                    out = block(xin)
+                    loss = loss_fn(out, yin)
+                loss_raw = jnp.mean(loss.data)
+                mutated = [h._data_ for h in handles]
+                return loss_raw, mutated
+            finally:
+                for h, s in zip(handles, saved):
+                    h._data_ = s
+                _random.pop_trace_key()
+                _TRACE_STATE.active = False
+
+        def step(params, opt_states, x, y, lr, key):
+            diff_idx = [i for i, d in enumerate(diff) if d]
+
+            def loss_of(diff_params):
+                full = list(params)
+                for i, p in zip(diff_idx, diff_params):
+                    full[i] = p
+                loss, mutated = run_forward(full, x, y, key)
+                return loss, mutated
+
+            (loss, mutated), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                [params[i] for i in diff_idx]
+            )
+            new_params = list(mutated)  # aux (BN stats) updates carried here
+            new_states = list(opt_states)
+            for k, i in enumerate(diff_idx):
+                w, s = rule_update(params[i], grads[k], opt_states[i], lr)
+                new_params[i] = w
+                new_states[i] = s
+            return new_params, new_states, loss
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, x, y, lr=0.01, sync=True):
+        if self._state is None:
+            # resolve deferred init with one tiny eager pass
+            xin = x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+            with autograd.predict_mode():
+                self.block(xin[0:1] if xin.shape[0] > 1 else xin)
+            self.init_state()
+        raw_x = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        raw_y = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self.mesh is not None:
+            raw_x = shard_batch(NDArray(raw_x), self.mesh, self.batch_axis)
+            raw_y = shard_batch(NDArray(raw_y), self.mesh, self.batch_axis)
+        if self._compiled is None:
+            self._compiled = self._build(None, None)
+        key = _random._next_key()
+        params, opt_states = self._state
+        new_params, new_states, loss = self._compiled(
+            params, opt_states, raw_x, raw_y, jnp.asarray(lr, raw_x.dtype
+                                                          if raw_x.dtype in (jnp.float32, jnp.bfloat16)
+                                                          else jnp.float32), key)
+        self._state = (new_params, new_states)
+        return float(loss) if sync else loss
+
+    def sync_to_block(self):
+        """Write the step's param state back into the Gluon parameters."""
+        params, _ = self._state
+        for h, raw in zip(self._handles, params):
+            h._set_data(raw)

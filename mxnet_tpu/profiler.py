@@ -1,0 +1,135 @@
+"""``mx.profiler`` — wraps ``jax.profiler``.
+
+Reference: ``python/mxnet/profiler.py`` + ``src/profiler/`` (SURVEY.md §5.1).
+The engine-integrated chrome://tracing dump maps to JAX's TensorBoard/
+perfetto trace; custom scopes map to ``jax.profiler.TraceAnnotation``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+_config = {"profile_all": False, "filename": "profile.json", "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_records = []
+
+
+def set_config(**kwargs):
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    _config["filename"] = filename
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    out = _config.get("filename", "profile.json")
+    trace_dir = os.path.splitext(out)[0] + "_trace"
+    os.makedirs(trace_dir, exist_ok=True)
+    jax.profiler.start_trace(trace_dir)
+    _state["running"] = True
+    _state["dir"] = trace_dir
+
+
+def stop(profile_process="worker"):
+    if _state["running"]:
+        jax.profiler.stop_trace()
+        _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dump(finished=True, profile_process="worker"):
+    stop()
+    return _state["dir"]
+
+
+def dumps(reset=False):
+    return "\n".join(f"{n}: {d * 1e3:.3f} ms" for n, d in _records)
+
+
+class ProfileTask:
+    """Named task scope (reference: ``profiler::ProfileTask``)."""
+
+    def __init__(self, name, domain=None):
+        self.name = name
+        self._ann = None
+        self._t0 = None
+
+    def start(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _records.append((self.name, time.perf_counter() - self._t0))
+            self._ann = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+ProfileEvent = ProfileTask
+Task = ProfileTask
+Event = ProfileTask
+
+
+class ProfileCounter:
+    def __init__(self, name, domain=None):
+        self.name = name
+        self.value = 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+Counter = ProfileCounter
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+
+class ProfileMarker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        pass
+
+
+def device_memory_profile(path=None):
+    """Device memory snapshot (reference analog: MXNET_MEMORY_PROFILE)."""
+    path = path or "memory.prof"
+    jax.profiler.save_device_memory_profile(path)
+    return path

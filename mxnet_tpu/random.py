@@ -1,0 +1,172 @@
+"""Random number handling.
+
+Reference: ``src/operator/random/`` + ``ResourceRequest::kRandom`` — stateful
+per-device PRNGs seeded by ``mx.random.seed``.
+
+TPU-native: JAX PRNG keys are functional; this module hides them behind the
+reference's stateful API (SURVEY.md §2.2 'random/': the one deliberate
+semantic change). A global key is split on every draw. Inside a CachedOp
+trace (hybridize) the key comes from a *traced* per-call key pushed onto
+``_TRACE_STACK`` so compiled steps get fresh randomness each invocation.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.trace_stack = []  # [(key_tracer, counter)]
+
+
+_S = _RngState()
+
+
+def seed(seed_state, ctx="all"):
+    _S.key = jax.random.PRNGKey(int(seed_state))
+
+
+def _next_key():
+    if _S.trace_stack:
+        key, cnt = _S.trace_stack[-1]
+        _S.trace_stack[-1] = (key, cnt + 1)
+        return jax.random.fold_in(key, cnt)
+    if _S.key is None:
+        seed(0)
+    _S.key, sub = jax.random.split(_S.key)
+    return sub
+
+
+def push_trace_key(key):
+    _S.trace_stack.append((key, 0))
+
+
+def pop_trace_key():
+    _S.trace_stack.pop()
+
+
+# --------------------------------------------------------------------------
+# sampling API (mx.random.* / mx.nd.random.*)
+# --------------------------------------------------------------------------
+
+
+def _wrap(raw, ctx=None, dtype=None):
+    from .ndarray.ndarray import NDArray
+    from .context import current_context
+
+    if dtype is not None:
+        raw = raw.astype(dtype)
+    return NDArray(raw, ctx=ctx or current_context())
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    r = jax.random.uniform(_next_key(), _shape(shape), jnp.dtype(dtype), low, high)
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    r = loc + scale * jax.random.normal(_next_key(), _shape(shape), jnp.dtype(dtype))
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None, **kw):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    if high is None:
+        low, high = 0, low
+    r = jax.random.randint(_next_key(), _shape(shape), low, high, jnp.dtype(dtype))
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    r = jax.random.gamma(_next_key(), alpha, _shape(shape), jnp.dtype(dtype)) * beta
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    r = jax.random.exponential(_next_key(), _shape(shape), jnp.dtype(dtype)) * scale
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    r = jax.random.poisson(_next_key(), lam, _shape(shape)).astype(jnp.dtype(dtype))
+    if out is not None:
+        out._set_data(r)
+        return out
+    return _wrap(r, ctx)
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    return _wrap(
+        jax.random.bernoulli(_next_key(), prob, _shape(shape)).astype(jnp.dtype(dtype)),
+        ctx,
+    )
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    from .ndarray.ndarray import NDArray
+
+    p = data.data if isinstance(data, NDArray) else jnp.asarray(data)
+    n = shape if isinstance(shape, int) else shape[0]
+    logits = jnp.log(jnp.maximum(p, 1e-38))
+    if p.ndim == 1:
+        s = jax.random.categorical(_next_key(), logits, shape=(n,))
+    else:
+        s = jax.random.categorical(_next_key(), logits[:, None, :], axis=-1,
+                                   shape=(p.shape[0], n))
+        if n == 1:
+            s = s[:, 0]
+    out = _wrap(s.astype(jnp.dtype(dtype)))
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1).reshape(-1, logits.shape[-1]),
+            s.reshape(-1, 1).astype(jnp.int32), axis=-1
+        ).reshape(s.shape)
+        return out, _wrap(lp)
+    return out
+
+
+def shuffle(data, **kw):
+    perm = jax.random.permutation(_next_key(), data.shape[0])
+    from .ndarray.ndarray import NDArray
+
+    return NDArray(jnp.take(data.data, perm, axis=0), ctx=data.ctx)
+
+
+# aliases used by the reference's older API surface
+sample_uniform = uniform
+sample_normal = normal
+sample_gamma = gamma
+sample_exponential = exponential
+sample_poisson = poisson
+negative_binomial = None  # registered lazily if needed

@@ -1,0 +1,220 @@
+"""RecordIO container format.
+
+Reference: ``python/mxnet/recordio.py`` + dmlc-core's RecordIO writer
+(magic ``0xced7230a``, length-prefixed 4-byte-aligned records) — format
+re-implemented from the documented wire layout (SURVEY.md §2.3) so packs
+produced by the reference's ``im2rec`` load unchanged. A C++ reader for the
+hot data path lives in ``cxx/recordio.cc``; this module is the API surface
+and pure-Python fallback.
+"""
+
+from __future__ import annotations
+
+import collections
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+from .base import MXNetError
+
+_MAGIC = 0xCED7230A
+_CFLAG_BITS = 29
+_LEN_MASK = (1 << _CFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference: ``MXRecordIO``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.handle = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.handle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.handle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"Invalid flag {self.flag}")
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+            self.pid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["handle"] = None
+        d["is_open"] = False
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if d.get("flag") is not None:
+            self.open()
+            if self.flag == "r":
+                pass
+
+    def _check_pid(self, allow_reset=False):
+        # after fork, reopen (reference does the same for C handles)
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise MXNetError("RecordIO handle used in a forked process")
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf):
+        assert self.writable
+        self._check_pid()
+        header = struct.pack("<II", _MAGIC, len(buf) & _LEN_MASK)
+        self.handle.write(header)
+        self.handle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.handle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        header = self.handle.read(8)
+        if len(header) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise MXNetError(f"Invalid RecordIO magic {magic:#x} in {self.uri}")
+        length = lrec & _LEN_MASK
+        buf = self.handle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.handle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO with a ``.idx`` sidecar (reference:
+    ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        self.fidx = open(self.idx_path, self.flag)
+        if not self.writable:
+            for line in iter(self.fidx.readline, ""):
+                line = line.strip().split("\t")
+                if len(line) < 2:
+                    continue
+                key = self.key_type(line[0])
+                self.idx[key] = int(line[1])
+                self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        super().close()
+        self.fidx.close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        d["fidx"] = None
+        return d
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        pos = self.idx[idx]
+        super().seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+# alias names used by gluon.data
+RecordIO = MXRecordIO
+IndexedRecordIO = MXIndexedRecordIO
+
+IRHeader = collections.namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a (header, bytes) pair into a record payload (reference:
+    ``recordio.pack``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+        return hdr + s
+    label = np.asarray(header.label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Unpack a record payload into (IRHeader, bytes)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[: flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (reference: ``recordio.pack_img``)."""
+    from .image import imencode
+
+    buf = imencode(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    header, img_bytes = unpack(s)
+    from .image import imdecode
+
+    img = imdecode(img_bytes, flag=1 if iscolor != 0 else 0, to_rgb=False)
+    return header, img.asnumpy()

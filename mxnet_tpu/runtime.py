@@ -1,0 +1,58 @@
+"""Runtime feature detection (reference: ``python/mxnet/runtime.py`` +
+``src/libinfo.cc``)."""
+
+from __future__ import annotations
+
+import jax
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+class Features(dict):
+    """Queryable feature set (reference: ``mx.runtime.Features``)."""
+
+    def __init__(self):
+        backend = "cpu"
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            pass
+        feats = {
+            "TPU": backend not in ("cpu", "gpu"),
+            "CUDA": False,
+            "CUDNN": False,
+            "XLA": True,
+            "PJIT": True,
+            "PALLAS": True,
+            "MKLDNN": False,
+            "OPENCV": _has_pillow(),
+            "DIST_KVSTORE": True,
+            "INT64_TENSOR_SIZE": True,
+            "SIGNAL_HANDLER": True,
+            "F16C": True,
+            "BF16": True,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def _has_pillow():
+    try:
+        import PIL  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def feature_list():
+    return list(Features().values())

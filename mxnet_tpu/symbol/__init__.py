@@ -1,0 +1,31 @@
+"""``mx.sym`` (reference: ``python/mxnet/symbol/``)."""
+
+from .symbol import (  # noqa: F401
+    Symbol,
+    var,
+    Variable,
+    Group,
+    load,
+    load_json,
+)
+from . import op  # noqa: F401
+from .op import *  # noqa: F401,F403
+from .executor import Executor, eval_symbol  # noqa: F401
+from . import op as _op_mod
+
+# make `mx.sym.FullyConnected(...)` etc. available at package level
+import sys as _sys
+
+_pkg = _sys.modules[__name__]
+for _n in dir(_op_mod):
+    if not _n.startswith("_") and not hasattr(_pkg, _n):
+        setattr(_pkg, _n, getattr(_op_mod, _n))
+
+zeros = None  # set below to avoid clobbering op namespace accidentally
+from ..ndarray.ndarray import zeros as _nd_zeros  # noqa: E402
+
+
+def zeros(shape, dtype="float32", **kw):  # symbolic zeros becomes a constant var
+    from .symbol import Symbol
+
+    return Symbol("_zeros_const", {"shape": tuple(shape), "dtype": dtype}, [])

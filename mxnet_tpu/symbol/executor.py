@@ -1,0 +1,215 @@
+"""Symbol graph evaluation + Executor.
+
+Reference: ``src/executor/graph_executor.cc`` (``GraphExecutor``,
+``SimpleBind``). TPU-native: the "memory planning / op attachment" passes
+are XLA's job — binding a graph means jitting one function that evaluates
+the node DAG; backward is ``jax.vjp`` over it (SURVEY.md §3.4 collapses to
+two compiled executables).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..ops import registry as _registry
+
+
+def _evaluate_graph(root, arg_dict, training=False, key=None):
+    """Evaluate the DAG with raw arrays for variables. Returns raw outputs."""
+    from .symbol import Symbol
+
+    heads = root._inputs if root._op == "_group" else [root]
+    cache = {}
+
+    def eval_node(node):
+        nid = id(node)
+        if nid in cache:
+            return cache[nid]
+        if node._op is None:
+            if node._name not in arg_dict:
+                raise MXNetError(f"missing argument {node._name}")
+            res = arg_dict[node._name]
+        elif node._op == "_full_scalar":
+            res = node._attrs["value"]
+        elif node._op == "_zeros_const":
+            res = jnp.zeros(node._attrs["shape"],
+                            node._attrs.get("dtype", "float32"))
+        elif node._op == "_group":
+            res = [eval_node(i) for i in node._inputs]
+        else:
+            raws = []
+            for i in node._inputs:
+                r = eval_node(i)
+                if isinstance(r, tuple) and i._num_outputs > 1:
+                    r = r[i._index]
+                raws.append(r)
+            opdef = _registry.get(node._op)
+            attrs = {k: v for k, v in node._attrs.items()
+                     if not k.startswith("__")}
+            if node._op == "Dropout":
+                if training and key is not None and attrs.get("p", 0.5) > 0:
+                    raws = [raws[0], jax.random.fold_in(key, nid % (2 ** 31))]
+                    attrs = {k: v for k, v in attrs.items() if k != "mode"}
+                    res = opdef.fn(*raws, **attrs)
+                else:
+                    res = raws[0]
+            elif node._op == "BatchNorm":
+                res = opdef.fn(*raws, training=False, **attrs)
+            else:
+                res = opdef.fn(*raws, **attrs)
+        cache[nid] = res
+        return res
+
+    outs = []
+    for h in heads:
+        r = eval_node(h)
+        if isinstance(r, tuple) and h._num_outputs > 1:
+            r = r[h._index]
+        outs.append(r)
+    return outs
+
+
+def eval_symbol(sym, arg_dict, training=False):
+    """Eager evaluation helper (used by SymbolBlock / Symbol.eval)."""
+    raw_args = {
+        k: (v.data if isinstance(v, NDArray) else jnp.asarray(v))
+        for k, v in arg_dict.items()
+    }
+    from .. import random as _random
+
+    key = _random._next_key() if training else None
+    outs = _evaluate_graph(sym, raw_args, training=training, key=key)
+    return [NDArray(o) for o in outs]
+
+
+class Executor:
+    """Bound computation graph (reference: ``Executor`` /
+    ``MXExecutorForward``)."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req="write",
+                 aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(arg_names, args))
+        self.arg_dict = dict(args or {})
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(arg_names, args_grad))
+        self.grad_dict = dict(args_grad or {})
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(symbol.list_auxiliary_states(), aux_states))
+        self.aux_dict = dict(aux_states or {})
+        self.grad_req = grad_req
+        self.outputs = []
+        self._fwd_jit = {}
+        self._vjp_fn = None
+        self.arg_arrays = [self.arg_dict[n] for n in arg_names
+                           if n in self.arg_dict]
+        self.grad_arrays = [self.grad_dict.get(n) for n in arg_names]
+        self.aux_arrays = [self.aux_dict[n]
+                           for n in symbol.list_auxiliary_states()
+                           if n in self.aux_dict]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(
+                    v.data if isinstance(v, NDArray) else jnp.asarray(v))
+            else:
+                self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+        raw_args = {k: v.data for k, v in self.arg_dict.items()}
+        raw_args.update({k: v.data for k, v in self.aux_dict.items()})
+        from .. import random as _random
+
+        key = _random._next_key()
+
+        sig = (tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                            for k, v in raw_args.items())), bool(is_train))
+        jitted = self._fwd_jit.get(sig)
+        if jitted is None:
+            symbol = self._symbol
+
+            def f(args_raw, k):
+                return _evaluate_graph(symbol, args_raw,
+                                       training=bool(is_train), key=k)
+
+            jitted = jax.jit(f)
+            self._fwd_jit[sig] = jitted
+
+        if is_train and self.grad_req != "null":
+            grad_names = [n for n in self._symbol.list_arguments()
+                          if self.grad_dict.get(n) is not None]
+
+            def f_diff(diff_raws):
+                merged = dict(raw_args)
+                merged.update(dict(zip(grad_names, diff_raws)))
+                return _evaluate_graph(self._symbol, merged, training=True,
+                                       key=key)
+
+            outs, vjp_fn = jax.vjp(f_diff, [raw_args[n] for n in grad_names])
+            self._vjp_fn = (vjp_fn, grad_names, [o for o in outs])
+        else:
+            outs = jitted(raw_args, key)
+            self._vjp_fn = None
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._vjp_fn is None:
+            raise MXNetError("call forward(is_train=True) before backward")
+        vjp_fn, grad_names, outs = self._vjp_fn
+        if out_grads is None:
+            cts = [jnp.ones_like(o) for o in outs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = [g.data for g in out_grads]
+        (grads,) = vjp_fn(cts)
+        for n, g in zip(grad_names, grads):
+            buf = self.grad_dict[n]
+            if self.grad_req == "add":
+                buf._set_data(buf.data + g)
+            else:
+                buf._set_data(g)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in arg_params.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._set_data(v.data)
+            elif not allow_extra_params:
+                raise MXNetError(f"extra param {k}")
+        if aux_params:
+            for k, v in aux_params.items():
+                if k in self.aux_dict:
+                    self.aux_dict[k]._set_data(v.data)
+                elif not allow_extra_params:
+                    raise MXNetError(f"extra aux {k}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        from ..ndarray.ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        arg_names = self._symbol.list_arguments()
+        new_args = {}
+        for n, s in zip(arg_names, arg_shapes):
+            old = self.arg_dict.get(n)
+            if old is not None and tuple(old.shape) == tuple(s):
+                new_args[n] = old
+            else:
+                new_args[n] = zeros(s, ctx=self._ctx)
+        return Executor(self._symbol, self._ctx, new_args,
+                        {n: zeros(s, ctx=self._ctx)
+                         for n, s in zip(arg_names, arg_shapes)}
+                        if self.grad_req != "null" else None,
+                        self.grad_req, self.aux_dict)

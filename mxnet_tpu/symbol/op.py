@@ -1,0 +1,58 @@
+"""Generated ``mx.sym.*`` namespace over the shared op registry.
+
+Reference: ``python/mxnet/symbol/register.py`` stub generation.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from .symbol import Symbol, var
+
+_THIS = sys.modules[__name__]
+
+
+def _num_outputs(opname, attrs):
+    if opname in ("split", "SliceChannel"):
+        return int(attrs.get("num_outputs", 1))
+    if opname == "split_v2":
+        if attrs.get("sections"):
+            return int(attrs["sections"])
+        return len(attrs.get("indices", ())) + 1
+    if opname == "topk" and attrs.get("ret_typ") == "both":
+        return 2
+    return 1
+
+
+def _make_sym_op(opdef):
+    def fn(*args, name=None, **kwargs):
+        inputs = []
+        attrs = {}
+        for a in args:
+            if isinstance(a, Symbol):
+                inputs.append(a)
+            elif a is None:
+                continue
+            else:
+                attrs_positional_err = a
+                raise TypeError(
+                    f"positional non-Symbol argument {a!r} for sym.{opdef.name}"
+                )
+        for k, v in kwargs.items():
+            if isinstance(v, Symbol):
+                inputs.append(v)
+            elif v is not None:
+                attrs[k] = tuple(v) if isinstance(v, list) else v
+        nout = _num_outputs(opdef.name, attrs)
+        return Symbol(opdef.name, attrs, inputs, name=name, num_outputs=nout)
+
+    fn.__name__ = opdef.name
+    return fn
+
+
+for _opname, _opdef in list(_registry.all_ops().items()):
+    if not hasattr(_THIS, _opname):
+        setattr(_THIS, _opname, _make_sym_op(_opdef))
+
+Variable = var

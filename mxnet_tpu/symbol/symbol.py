@@ -1,0 +1,430 @@
+"""Symbol: lazy graph construction API.
+
+Reference: ``python/mxnet/symbol/symbol.py`` + nnvm graph (``SaveJSON``).
+TPU-native: a Symbol is a lightweight DAG of (op, attrs, inputs); shape
+inference runs via ``jax.eval_shape`` over the same op implementations the
+imperative path uses (single source of truth — no separate FInferShape
+registry), and binding compiles the whole graph with ``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as _np
+
+from .. import name as _name_mod
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+# ops whose trailing inputs are auxiliary states (not gradient arguments)
+_AUX_INPUTS = {"BatchNorm": ("moving_mean", "moving_var")}
+_OP_INPUT_NAMES = {
+    "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    "FullyConnected": ("data", "weight", "bias"),
+    "Convolution": ("data", "weight", "bias"),
+    "Deconvolution": ("data", "weight", "bias"),
+    "Embedding": ("data", "weight"),
+    "LayerNorm": ("data", "gamma", "beta"),
+    "InstanceNorm": ("data", "gamma", "beta"),
+    "GroupNorm": ("data", "gamma", "beta"),
+    "RNN": ("data", "parameters", "state", "state_cell"),
+    "SoftmaxOutput": ("data", "label"),
+}
+
+
+class Symbol:
+    """A node in the symbolic graph (possibly selecting one output)."""
+
+    __array_priority__ = 1000.0
+
+    def __init__(self, op, attrs, inputs, name=None, index=0, num_outputs=1):
+        self._op = op  # None for variables; "_group" for groups
+        self._attrs = attrs or {}
+        self._inputs = inputs or []
+        self._index = index
+        self._num_outputs = num_outputs
+        if name is None and op is not None and op != "_group":
+            name = _name_mod.next_name(op.lower())
+        self._name = name
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def list_attr(self):
+        return {k: str(v) for k, v in self._attrs.items()}
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def __len__(self):
+        if self._op == "_group":
+            return len(self._inputs)
+        return self._num_outputs
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            internals = self.get_internals()
+            for s in internals._inputs:
+                if s._name == index or f"{s._name}_output" == index:
+                    return s
+            raise MXNetError(f"no internal symbol named {index}")
+        if self._op == "_group":
+            return self._inputs[index]
+        if index >= max(self._num_outputs, 1):
+            raise IndexError(index)
+        if self._num_outputs == 1:
+            return self
+        return Symbol(self._op, self._attrs, self._inputs, self._name,
+                      index=index, num_outputs=self._num_outputs)
+
+    # -- graph walks ------------------------------------------------------
+    def _topo(self):
+        order, seen = [], set()
+        stack = [(self, False)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for i in node._inputs:
+                stack.append((i, False))
+        # dedupe multi-output views: keep first occurrence per base
+        return order
+
+    def list_arguments(self):
+        args = []
+        seen = set()
+        for node in self._topo():
+            if node._op is None and node._name not in seen \
+                    and not node._attrs.get("__aux__"):
+                seen.add(node._name)
+                args.append(node._name)
+        return args
+
+    def list_auxiliary_states(self):
+        auxs = []
+        seen = set()
+        for node in self._topo():
+            if node._op is None and node._attrs.get("__aux__") \
+                    and node._name not in seen:
+                seen.add(node._name)
+                auxs.append(node._name)
+        return auxs
+
+    def list_outputs(self):
+        if self._op == "_group":
+            out = []
+            for s in self._inputs:
+                out.extend(s.list_outputs())
+            return out
+        if self._num_outputs == 1:
+            return [f"{self._name}_output"]
+        return [f"{self._name}_output{self._index}"]
+
+    def list_inputs(self):
+        return self.list_arguments() + self.list_auxiliary_states()
+
+    def get_internals(self):
+        nodes = [n for n in self._topo()]
+        return Symbol("_group", {}, nodes, name="internals")
+
+    def get_children(self):
+        if not self._inputs:
+            return None
+        return Symbol("_group", {}, list(self._inputs), name="children")
+
+    # -- composition ------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Compose: rebind variable inputs (reference: ``Symbol.__call__``)."""
+        s = self._deepcopy()
+        s._compose(*args, **kwargs)
+        return s
+
+    def _deepcopy(self, memo=None):
+        memo = memo if memo is not None else {}
+        if id(self) in memo:
+            return memo[id(self)]
+        cp = Symbol(self._op, dict(self._attrs),
+                    [i._deepcopy(memo) for i in self._inputs], self._name,
+                    self._index, self._num_outputs)
+        memo[id(self)] = cp
+        return cp
+
+    def _compose(self, *args, **kwargs):
+        by_name = dict(kwargs)
+        pos = list(args)
+        for node in self._topo():
+            for i, inp in enumerate(node._inputs):
+                if inp._op is None:
+                    if inp._name in by_name:
+                        node._inputs[i] = by_name[inp._name]
+                    elif pos:
+                        node._inputs[i] = pos.pop(0)
+
+    # -- shape/type inference --------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(False, *args, **kwargs)
+        except Exception as e:
+            raise MXNetError(f"infer_shape failed: {e}") from e
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self._infer_shape_impl(True, *args, **kwargs)
+        except Exception:
+            return (None, None, None)
+
+    def _infer_shape_impl(self, partial, *args, **kwargs):
+        import jax
+
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        shapes = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    shapes[n] = s
+        shapes.update({k: v for k, v in kwargs.items() if v is not None})
+
+        known = dict(shapes)
+        # iterative local propagation using eval_shape per node
+        out_shapes, arg_out, aux_out = _infer_graph_shapes(self, known)
+        args_res = [arg_out.get(n) for n in arg_names]
+        auxs_res = [aux_out.get(n) for n in aux_names]
+        return (args_res, out_shapes, auxs_res)
+
+    def infer_type(self, *args, **kwargs):
+        arg_names = self.list_arguments()
+        dtypes = {}
+        if args:
+            for n, t in zip(arg_names, args):
+                dtypes[n] = t
+        dtypes.update(kwargs)
+        default = _np.float32
+        args_res = [_np.dtype(dtypes.get(n, default)) for n in arg_names]
+        outs = [
+            _np.dtype(default) for _ in self.list_outputs()
+        ]
+        auxs = [_np.dtype(default) for _ in self.list_auxiliary_states()]
+        return (args_res, outs, auxs)
+
+    # -- evaluation -------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):
+        from .executor import eval_symbol
+
+        return eval_symbol(self, kwargs)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        from .executor import Executor
+        from ..ndarray.ndarray import zeros
+
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        if arg_shapes is None or any(s is None for s in arg_shapes):
+            raise MXNetError("simple_bind could not infer all argument shapes")
+        args = {n: zeros(s, ctx=ctx) for n, s in zip(arg_names, arg_shapes)}
+        args_grad = {
+            n: zeros(s, ctx=ctx)
+            for n, s in zip(arg_names, arg_shapes)
+        } if grad_req != "null" else None
+        auxs = {n: zeros(s, ctx=ctx) for n, s in zip(aux_names, aux_shapes or [])}
+        return Executor(self, ctx, args, args_grad, grad_req, auxs)
+
+    # -- save/load --------------------------------------------------------
+    def tojson(self):
+        """Serialize (format: same node-list idea as nnvm SaveJSON)."""
+        nodes = []
+        node_ids = {}
+        for node in self._topo():
+            if id(node) in node_ids:
+                continue
+            node_ids[id(node)] = len(nodes)
+            nodes.append(node)
+        blob = {
+            "nodes": [
+                {
+                    "op": n._op or "null",
+                    "name": n._name,
+                    "attrs": {k: _json_attr(v) for k, v in n._attrs.items()},
+                    "inputs": [[node_ids[id(i)], i._index, 0] for i in n._inputs],
+                }
+                for n in nodes
+            ],
+            "heads": [[node_ids[id(self)], self._index, 0]]
+            if self._op != "_group"
+            else [[node_ids[id(s)], s._index, 0] for s in self._inputs],
+            "mxtpu_version": 1,
+        }
+        return json.dumps(blob, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- operators --------------------------------------------------------
+    def _binop(self, opname, other, reverse=False):
+        from . import op as _sym_op
+
+        fn = getattr(_sym_op, opname)
+        if not isinstance(other, Symbol):
+            other = _scalar_sym(other)
+        a, b = (other, self) if reverse else (self, other)
+        return fn(a, b)
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binop("broadcast_add", o, True)
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binop("broadcast_mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __neg__(self):
+        return self._binop("broadcast_mul", -1.0)
+
+    def reshape(self, *shape, **kwargs):
+        from . import op as _sym_op
+
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        elif len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _sym_op.reshape(self, shape=tuple(shape))
+
+
+def _json_attr(v):
+    if isinstance(v, tuple):
+        return list(v)
+    return v
+
+
+def _scalar_sym(value):
+    return Symbol("_full_scalar", {"value": float(value)}, [], name=None)
+
+
+def var(name, attr=None, shape=None, lr_mult=None, wd_mult=None, dtype=None,
+        init=None, stype=None, **kwargs):
+    """Create a variable symbol (reference: ``sym.var``/``sym.Variable``)."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype))
+    attrs.update(kwargs)
+    return Symbol(None, attrs, [], name=name)
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol("_group", {}, list(symbols), name="group")
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    blob = json.loads(json_str)
+    nodes = []
+    for n in blob["nodes"]:
+        attrs = {k: (tuple(v) if isinstance(v, list) else v)
+                 for k, v in n.get("attrs", {}).items()}
+        if n["op"] == "null":
+            sym = Symbol(None, attrs, [], name=n["name"])
+        else:
+            inputs = [nodes[i][idx] if nodes[i]._num_outputs > 1 else nodes[i]
+                      for i, idx, _ in n["inputs"]]
+            nout = _num_outputs_of(n["op"], attrs)
+            sym = Symbol(n["op"], attrs, inputs, name=n["name"],
+                         num_outputs=nout)
+        nodes.append(sym)
+    heads = [nodes[i][idx] if nodes[i]._num_outputs > 1 else nodes[i]
+             for i, idx, _ in blob["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def _num_outputs_of(op, attrs):
+    if op in ("split", "SliceChannel"):
+        return int(attrs.get("num_outputs", 1))
+    return 1
+
+
+def _infer_graph_shapes(root, known_shapes):
+    """Run abstract evaluation over the graph with jax.eval_shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from .executor import _evaluate_graph
+
+    arg_names = root.list_arguments() + root.list_auxiliary_states()
+    missing = [n for n in arg_names if n not in known_shapes]
+    # pull shapes recorded on var attrs
+    for node in root._topo():
+        if node._op is None and node._name in missing:
+            s = node._attrs.get("__shape__")
+            if s and all(d > 0 for d in s):
+                known_shapes[node._name] = s
+                missing.remove(node._name)
+    if missing:
+        # try local propagation for common layer params by evaluating
+        # progressively is complex; report unknown
+        return (None, None, None)
+
+    structs = {
+        n: jax.ShapeDtypeStruct(tuple(known_shapes[n]), jnp.float32)
+        for n in arg_names
+    }
+
+    def fn(arg_dict):
+        outs = _evaluate_graph(root, arg_dict, training=False)
+        return outs
+
+    out_struct = jax.eval_shape(fn, structs)
+    out_shapes = [tuple(o.shape) for o in out_struct]
+    arg_out = {n: tuple(known_shapes[n]) for n in root.list_arguments()}
+    aux_out = {n: tuple(known_shapes[n]) for n in root.list_auxiliary_states()}
+    return out_shapes, arg_out, aux_out

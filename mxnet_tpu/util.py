@@ -1,0 +1,55 @@
+"""Misc utilities (reference: ``python/mxnet/util.py``)."""
+
+from __future__ import annotations
+
+import functools
+
+_np_array = False
+_np_shape = False
+
+
+def is_np_array():
+    return _np_array
+
+
+def is_np_shape():
+    return _np_shape
+
+
+def set_np(shape=True, array=True):
+    global _np_array, _np_shape
+    _np_array, _np_shape = array, shape
+
+
+def reset_np():
+    set_np(False, False)
+
+
+def use_np(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        return func(*args, **kwargs)
+
+    return wrapper
+
+
+def makedirs(d):
+    import os
+
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+
+    try:
+        stats = jax.devices()[dev_id].memory_stats()
+        return (stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0))
+    except Exception:
+        return (0, 0)

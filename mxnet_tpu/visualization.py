@@ -1,0 +1,38 @@
+"""Network visualization (reference: ``python/mxnet/visualization.py``)."""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer table for a Symbol graph (reference: ``print_summary``)."""
+    nodes = symbol.get_internals().list_outputs() if hasattr(symbol, "get_internals") else []
+    header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    positions = [int(line_length * p) for p in positions]
+
+    def print_row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line += str(f)
+            line = line[: positions[i]]
+            line += " " * (positions[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(header)
+    print("=" * line_length)
+    total = 0
+    for node in getattr(symbol, "_graph_nodes", lambda: [])() if callable(getattr(symbol, "_graph_nodes", None)) else []:
+        print_row([f"{node.name} ({node.op})", "-", 0, ",".join(i.name for i in node.inputs)])
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    raise MXNetError(
+        "plot_network requires graphviz which is not available in this "
+        "environment; use print_summary or Block.summary instead"
+    )

@@ -1,0 +1,40 @@
+"""Test configuration: force the XLA:CPU backend with 8 virtual devices.
+
+Mirrors the reference's test strategy (SURVEY.md §4): the CPU suite is the
+source of truth; TPU runs reuse it by flipping the default context. The
+8-device host platform lets collective/sharding tests run without TPUs.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+# the axon sitecustomize pins JAX_PLATFORMS=axon; override before first use
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    """Deterministic per-test RNG (reference: common.py:with_seed)."""
+    import random
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    np.random.seed(1234)
+    random.seed(1234)
+    mx.random.seed(1234)
+    yield

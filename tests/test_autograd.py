@@ -1,0 +1,170 @@
+"""Tape autograd tests (reference model: test_autograd.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy() + 2)
+
+
+def test_chain_and_branches():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = a * a + x
+    b.backward()
+    # d/dx (9x^2 + x) = 18x + 1 = 37
+    assert_almost_equal(x.grad, np.array([37.0], np.float32))
+
+
+def test_grad_req_add():
+    x = mx.nd.array([1.0, 1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, np.array([6.0, 6.0], np.float32))
+
+
+def test_grad_req_null():
+    x = mx.nd.array([1.0])
+    x.attach_grad(grad_req="null")
+    assert x.grad is None
+
+
+def test_head_gradient():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(mx.nd.array([2.0, 0.5]))
+    assert_almost_equal(x.grad, np.array([4.0, 2.0], np.float32))
+
+
+def test_detach():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    assert_almost_equal(x.grad, np.array([4.0], np.float32))  # y treated const
+
+
+def test_stop_gradient_op():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.stop_gradient(x * x) * x
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0], np.float32))
+
+
+def test_pause():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 3  # not recorded
+        w = y + 1
+    w.backward()
+    assert_almost_equal(x.grad, np.array([2.0], np.float32))
+
+
+def test_train_predict_mode():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        assert autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not autograd.is_recording()
+
+
+def test_intermediate_attach_grad():
+    x = mx.nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        y.attach_grad()
+        z = y * 2
+    z.backward()
+    assert_almost_equal(y.grad, np.array([2.0], np.float32))
+
+
+def test_autograd_grad_api():
+    x = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    (gx,) = [autograd.grad(y, [x])[0]] if False else [autograd.grad(y, [x])[0]]
+    assert_almost_equal(gx, 2 * x.asnumpy())
+
+
+def test_multi_output_backward():
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        parts = mx.nd.split(x, num_outputs=2, axis=1)
+        s = parts[0].sum() + (parts[1] * 2).sum()
+    s.backward()
+    assert_almost_equal(x.grad, np.array([[1, 2], [1, 2]], np.float32))
+
+
+def test_retain_graph():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    assert_almost_equal(x.grad, g1)  # write (not add) semantics
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.5, -1.0])
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-4, atol=1e-5)
+
+
+def test_backward_through_mutation_snapshot():
+    """The tape captures values at op time; later mutation doesn't corrupt it."""
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    x *= 10  # mutate after record
+    y.backward()
+    assert_almost_equal(x.grad, np.array([4.0], np.float32))

@@ -1,0 +1,265 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests (reference model:
+tests/python/unittest/test_gluon.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(init=mx.initializer.One(), ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert (p.data().asnumpy() == 1).all()
+    assert p.grad().shape == (3, 4)
+    p.set_data(mx.nd.zeros((3, 4)))
+    assert (p.data().asnumpy() == 0).all()
+
+
+def test_parameter_deferred():
+    p = gluon.Parameter("w", shape=(5, 0), allow_deferred_init=True)
+    p.initialize(ctx=mx.cpu())
+    with pytest.raises(gluon.DeferredInitializationError):
+        p.data()
+    p.shape = (5, 7)
+    assert p.data().shape == (5, 7)
+
+
+def test_dense_shapes_and_values():
+    layer = nn.Dense(4, in_units=3, use_bias=True)
+    layer.initialize(init=mx.initializer.One())
+    x = mx.nd.ones((2, 3))
+    out = layer(x)
+    # weight -> ones (3 per row); bias dispatches to zeros by name
+    assert_almost_equal(out, np.full((2, 4), 3.0, np.float32))
+
+
+def test_deferred_infer_dense():
+    layer = nn.Dense(7)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 7)
+    assert layer.weight.shape == (7, 5)
+
+
+def test_hybrid_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(4, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5, atol=1e-6)
+    # second call goes through the cache
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(hybrid, hybrid2)
+
+
+def test_hybrid_grad_consistency():
+    def run(hybridize):
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        net.initialize(init=mx.initializer.Xavier())
+        # identical params via fixed numpy seed
+        for i, p in enumerate(sorted(net.collect_params().keys())):
+            param = net.collect_params()[p]
+        if hybridize:
+            net.hybridize()
+        x = mx.nd.array(np.linspace(-1, 1, 12).reshape(3, 4))
+        with autograd.record():
+            out = net(x)
+            loss = (out * out).sum()
+        loss.backward()
+        return {k: v.grad().asnumpy()
+                for k, v in net.collect_params().items()
+                if v.grad_req != "null"}, \
+               {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+
+    np.random.seed(42)
+    g_eager, p_eager = run(False)
+    np.random.seed(42)
+    g_hybrid, p_hybrid = run(True)
+    for k in p_eager:
+        np.testing.assert_allclose(p_eager[k], p_hybrid[list(p_hybrid)[
+            list(p_eager).index(k)]], rtol=1e-6)
+    ge = [g_eager[k] for k in sorted(g_eager)]
+    gh = [g_hybrid[k] for k in sorted(g_hybrid)]
+    for a, b in zip(ge, gh):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(), nn.Activation("relu"),
+            nn.MaxPool2D(2), nn.Flatten(), nn.Dense(3))
+    net.initialize()
+    out = net(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 3)
+    net.hybridize()
+    out2 = net(mx.nd.ones((2, 3, 8, 8)))
+    assert_almost_equal(out, out2.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_moving_stats_eager_and_hybrid():
+    for hybridize in (False, True):
+        bn = nn.BatchNorm(in_channels=3)
+        bn.initialize()
+        if hybridize:
+            bn.hybridize()
+        x = mx.nd.random.normal(loc=2.0, shape=(4, 3, 5, 5))
+        _ = bn(x)  # inference: stats unchanged
+        rm0 = bn.running_mean.data().asnumpy().copy()
+        assert_almost_equal(rm0, np.zeros(3, np.float32))
+        with autograd.record():
+            out = bn(x)
+        rm1 = bn.running_mean.data().asnumpy()
+        assert not np.allclose(rm1, rm0), f"hybridize={hybridize}"
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = mx.nd.ones((100, 100))
+    out_inf = do(x)
+    assert_almost_equal(out_inf, x.asnumpy())  # identity at inference
+    with autograd.record():
+        out_train = do(x)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding_block():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(mx.nd.array([[1.0, 2.0], [3.0, 4.0]]))
+    assert out.shape == (2, 2, 4)
+
+
+def test_save_load_parameters(tmp_path):
+    fname = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, in_units=4), nn.Dense(2, in_units=5))
+    net.initialize()
+    ref = net(mx.nd.ones((1, 4))).asnumpy()
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(5, in_units=4), nn.Dense(2, in_units=5))
+    net2.load_parameters(fname)
+    out = net2(mx.nd.ones((1, 4))).asnumpy()
+    assert_almost_equal(ref, out)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize(init=mx.initializer.One())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = mx.nd.array([[2.0]])
+    with autograd.record():
+        y = net(x)  # w*2, w=1
+        loss = y * y  # (2w)^2 -> dL/dw = 8w = 8
+    loss.backward()
+    trainer.step(1)
+    # w = 1 - 0.5*8 = -3
+    assert_almost_equal(net.weight.data(), np.array([[-3.0]], np.float32))
+
+
+def test_trainer_lr_scheduler():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    sched = mx.lr_scheduler.FactorScheduler(step=1, factor=0.5)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched})
+    assert trainer.learning_rate == pytest.approx(1.0)
+
+
+def test_mlp_convergence():
+    """Tiny end-to-end convergence (the S1 milestone — SURVEY.md §7)."""
+    np.random.seed(0)
+    mx.random.seed(0)
+    n = 256
+    x_np = np.random.randn(n, 10).astype(np.float32)
+    w_true = np.random.randn(10, 3).astype(np.float32)
+    y_np = (x_np @ w_true).argmax(1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x, y = mx.nd.array(x_np), mx.nd.array(y_np)
+    for epoch in range(60):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(n)
+    preds = net(x).asnumpy().argmax(1)
+    acc = (preds == y_np).mean()
+    assert acc > 0.9, f"convergence failed: acc={acc}"
+
+
+def test_sequential_getitem_len():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3), nn.Dense(4), nn.Dense(5))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, in_units=2), nn.Dense(4, in_units=3))
+    net.initialize()
+    weights = net.collect_params(".*weight")
+    assert all("weight" in k for k in weights.keys())
+    assert len(weights) == 2
+
+
+def test_losses():
+    pred = mx.nd.array([[1.0, 2.0], [0.5, 0.5]])
+    label = mx.nd.array([[1.5, 1.5], [1.0, 0.0]])
+    l2 = gluon.loss.L2Loss()(pred, label)
+    ref = ((pred.asnumpy() - label.asnumpy()) ** 2).mean(1) / 2
+    assert_almost_equal(l2, ref, rtol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, label)
+    assert_almost_equal(l1, np.abs(pred.asnumpy() - label.asnumpy()).mean(1),
+                        rtol=1e-5)
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()(
+        mx.nd.array([[10.0, 0.0]]), mx.nd.array([0.0]))
+    assert float(ce.asscalar()) < 0.01
+    bce = gluon.loss.SigmoidBCELoss()(mx.nd.array([[10.0]]), mx.nd.array([[1.0]]))
+    assert float(bce.asscalar()) < 0.01
+    hu = gluon.loss.HuberLoss()(pred, label)
+    assert hu.shape == (2,)
+
+
+def test_lambda_blocks():
+    lam = nn.HybridLambda(lambda F, x: x * 2)
+    out = lam(mx.nd.ones((2, 2)))
+    assert_almost_equal(out, np.full((2, 2), 2.0, np.float32))
+    lam2 = nn.Lambda("tanh")
+    out2 = lam2(mx.nd.zeros((2,)))
+    assert_almost_equal(out2, np.zeros(2, np.float32))
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(3, in_units=2))
+    net.initialize()
+    repr(net)
+    net.summary()
+
+
+def test_cast():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().dtype == np.float16

@@ -1,0 +1,102 @@
+"""KVStore tests (reference model: tests/python/unittest/test_kvstore.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+SHAPE = (4, 4)
+
+
+def test_init_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.ones(SHAPE, np.float32))
+
+
+def test_push_aggregation():
+    kv = mx.kv.create("local")
+    kv.init(3, mx.nd.ones(SHAPE))
+    # push from 4 "devices": merged value is the sum
+    kv.push(3, [mx.nd.ones(SHAPE) * 2] * 4)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 8.0, np.float32))
+
+
+def test_pushpull_allreduce_semantics():
+    """Trainer path: aggregate + broadcast WITHOUT touching stored weight."""
+    kv = mx.kv.create("device")
+    kv.init("w", mx.nd.ones(SHAPE))
+    grads = [mx.nd.ones(SHAPE) * i for i in range(1, 4)]
+    kv.pushpull("w", grads, out=grads)
+    for g in grads:
+        assert_almost_equal(g, np.full(SHAPE, 6.0, np.float32))
+    stored = mx.nd.zeros(SHAPE)
+    kv.pull("w", out=stored)
+    assert_almost_equal(stored, np.ones(SHAPE, np.float32))  # untouched
+
+
+def test_updater():
+    kv = mx.kv.create("local")
+    kv.init(1, mx.nd.ones(SHAPE))
+
+    def updater(key, grad, weight):
+        weight -= 0.1 * grad
+
+    kv.set_updater(updater)
+    kv.push(1, [mx.nd.ones(SHAPE)] * 2)  # merged grad = 2
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(1, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 0.8, np.float32))
+
+
+def test_set_optimizer():
+    kv = mx.kv.create("local")
+    kv.init(0, mx.nd.ones(SHAPE))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+    kv.push(0, [mx.nd.ones(SHAPE)])
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(0, out=out)
+    assert_almost_equal(out, np.full(SHAPE, 0.5, np.float32))
+
+
+def test_list_kv():
+    kv = mx.kv.create("local")
+    keys = [5, 7, 9]
+    kv.init(keys, [mx.nd.ones(SHAPE)] * 3)
+    kv.push(keys, [[mx.nd.ones(SHAPE) * 4]] * 3)
+    outs = [mx.nd.zeros(SHAPE) for _ in keys]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        assert_almost_equal(o, np.full(SHAPE, 4.0, np.float32))
+
+
+def test_dist_tpu_sync_single_process():
+    kv = mx.kv.create("dist_tpu_sync")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init("x", mx.nd.ones(SHAPE))
+    kv.push("x", [mx.nd.ones(SHAPE) * 3])
+    out = mx.nd.zeros(SHAPE)
+    kv.pull("x", out=out)
+    assert_almost_equal(out, np.full(SHAPE, 3.0, np.float32))
+    kv.barrier()
+
+
+def test_type_aliases():
+    assert mx.kv.create("nccl").type == "nccl"
+    assert mx.kv.create("dist_sync").rank == 0
+
+
+def test_row_sparse_pull():
+    kv = mx.kv.create("local")
+    w = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    kv.init("emb", w)
+    out = mx.nd.zeros((4, 3))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([1, 3]))
+    expected = np.zeros((4, 3), np.float32)
+    expected[[1, 3]] = w.asnumpy()[[1, 3]]
+    assert_almost_equal(out, expected)

@@ -1,0 +1,185 @@
+"""NDArray semantics tests (reference model: tests/python/unittest/test_ndarray.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal, with_seed
+
+
+def test_creation():
+    a = mx.nd.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == np.float32
+    b = mx.nd.ones((4,), dtype="int32")
+    assert b.dtype == np.int32
+    c = mx.nd.full((2, 2), 7.0)
+    assert (c.asnumpy() == 7).all()
+    d = mx.nd.array([[1, 2], [3, 4]])
+    assert d.shape == (2, 2)
+    e = mx.nd.arange(0, 10, 2)
+    assert_almost_equal(e, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = mx.nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, np.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, np.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(a + 1, a.asnumpy() + 1)
+    assert_almost_equal(2 * a, 2 * a.asnumpy())
+    assert_almost_equal(2 - a, 2 - a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+
+
+def test_inplace():
+    a = mx.nd.ones((2, 2))
+    orig = a
+    a += 1
+    assert orig is a
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+
+
+def test_comparison():
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    b = mx.nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a == b, np.array([0, 1, 0], dtype=np.float32))
+    assert_almost_equal(a < b, np.array([1, 0, 0], dtype=np.float32))
+    assert_almost_equal(a >= b, np.array([0, 1, 1], dtype=np.float32))
+
+
+def test_indexing_basic():
+    a = mx.nd.array(np.arange(24).reshape(2, 3, 4))
+    np_a = np.arange(24).reshape(2, 3, 4)
+    assert_almost_equal(a[0], np_a[0])
+    assert_almost_equal(a[1, 2], np_a[1, 2])
+    assert_almost_equal(a[:, 1:3], np_a[:, 1:3])
+    assert_almost_equal(a[0, :, ::2], np_a[0, :, ::2])
+
+
+def test_view_aliasing():
+    """b = a[1:3]; b[:] = 0 mutates a (reference shared-memory views)."""
+    a = mx.nd.array(np.arange(10, dtype=np.float32))
+    b = a[2:5]
+    b[:] = 0
+    expected = np.arange(10, dtype=np.float32)
+    expected[2:5] = 0
+    assert_almost_equal(a, expected)
+    # mutations of a are visible through b
+    a[3] = 99
+    assert float(b[1].asscalar()) == 99
+
+
+def test_setitem():
+    a = mx.nd.zeros((3, 3))
+    a[1] = 1.0
+    a[0, 2] = 5.0
+    a[2, :] = mx.nd.array([7.0, 8.0, 9.0])
+    exp = np.zeros((3, 3), np.float32)
+    exp[1] = 1
+    exp[0, 2] = 5
+    exp[2] = [7, 8, 9]
+    assert_almost_equal(a, exp)
+
+
+def test_reshape_special_codes():
+    a = mx.nd.zeros((2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, 1, 3, 4)).shape == (2, 1, 3, 4)
+    assert a.reshape((0, 0, -1)).shape == (2, 3, 4)
+    assert a.reshape(6, 4).shape == (6, 4)  # varargs form
+
+
+def test_astype_copy():
+    a = mx.nd.ones((2, 2))
+    b = a.astype("float16")
+    assert b.dtype == np.float16
+    c = a.astype(np.float32, copy=False)
+    assert c is a
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.save")
+    a = mx.nd.array([[1.0, 2.0]])
+    b = mx.nd.arange(0, 4)
+    mx.nd.save(fname, [a, b])
+    loaded = mx.nd.load(fname)
+    assert isinstance(loaded, list)
+    assert_almost_equal(loaded[0], a)
+    assert_almost_equal(loaded[1], b)
+    mx.nd.save(fname, {"x": a, "y": b})
+    loaded = mx.nd.load(fname)
+    assert set(loaded.keys()) == {"x", "y"}
+    assert_almost_equal(loaded["x"], a)
+
+
+def test_scalar_conversion():
+    a = mx.nd.array([3.5])
+    assert a.asscalar() == pytest.approx(3.5)
+    assert float(a) == pytest.approx(3.5)
+    assert int(mx.nd.array([7])) == 7
+    with pytest.raises(ValueError):
+        mx.nd.ones((2,)).asscalar()
+
+
+def test_methods():
+    a = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    assert_almost_equal(a.sum(), np.float32(10))
+    assert_almost_equal(a.sum(axis=0), np.array([4, 6], np.float32))
+    assert_almost_equal(a.mean(axis=1), np.array([1.5, 3.5], np.float32))
+    assert_almost_equal(a.max(), np.float32(4))
+    assert_almost_equal(a.T, a.asnumpy().T)
+    assert_almost_equal(a.flatten(), a.asnumpy().reshape(2, 2))
+    assert a.expand_dims(0).shape == (1, 2, 2)
+    assert_almost_equal(a.clip(a_min=1.5, a_max=3.5),
+                        np.clip(a.asnumpy(), 1.5, 3.5))
+
+
+def test_waitall_and_sync():
+    a = mx.nd.ones((100, 100))
+    b = a @ a
+    b.wait_to_read()
+    mx.nd.waitall()
+
+
+def test_copyto_and_context():
+    a = mx.nd.ones((2, 2), ctx=mx.cpu())
+    b = mx.nd.zeros((2, 2), ctx=mx.cpu())
+    a.copyto(b)
+    assert (b.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu())
+    assert c is a
+    assert a.context.device_type in ("cpu",)
+
+
+def test_zeros_ones_like():
+    a = mx.nd.array(np.random.rand(3, 3))
+    assert (mx.nd.zeros_like(a).asnumpy() == 0).all()
+    assert (mx.nd.ones_like(a).asnumpy() == 1).all()
+
+
+def test_concat_stack():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.zeros((2, 3))
+    c = mx.nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    d = mx.nd.stack(a, b, axis=0)
+    assert d.shape == (2, 2, 3)
+
+
+def test_pickle():
+    import pickle
+
+    a = mx.nd.array([[1.0, 2.0]])
+    b = pickle.loads(pickle.dumps(a))
+    assert_almost_equal(a, b)

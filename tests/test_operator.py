@@ -1,0 +1,290 @@
+"""Operator correctness vs NumPy + numeric gradient checks.
+
+Reference model: tests/python/unittest/test_operator.py (forward vs numpy,
+backward vs central differences).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import (
+    assert_almost_equal,
+    check_numeric_gradient,
+    with_seed,
+)
+
+
+def test_unary_ops():
+    x = np.random.uniform(0.5, 2.0, (3, 4)).astype(np.float32)
+    a = mx.nd.array(x)
+    for name, ref in [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+        ("square", np.square), ("abs", np.abs), ("sin", np.sin),
+        ("cos", np.cos), ("tanh", np.tanh), ("floor", np.floor),
+        ("ceil", np.ceil), ("sign", np.sign), ("log1p", np.log1p),
+    ]:
+        assert_almost_equal(getattr(mx.nd, name)(a), ref(x), rtol=1e-5,
+                            atol=1e-5, names=(name, "np"))
+    assert_almost_equal(mx.nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    assert_almost_equal(mx.nd.relu(a - 1), np.maximum(x - 1, 0), rtol=1e-5)
+
+
+def test_broadcast_ops():
+    a = np.random.rand(2, 1, 3).astype(np.float32)
+    b = np.random.rand(1, 4, 3).astype(np.float32)
+    ma, mb = mx.nd.array(a), mx.nd.array(b)
+    assert_almost_equal(mx.nd.broadcast_add(ma, mb), a + b, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_mul(ma, mb), a * b, rtol=1e-5)
+    assert_almost_equal(mx.nd.broadcast_maximum(ma, mb), np.maximum(a, b))
+    assert_almost_equal(mx.nd.broadcast_power(ma + 1, mb), (a + 1) ** b, rtol=1e-4)
+
+
+def test_reductions():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.sum(a), x.sum(), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a, axis=1), x.sum(1), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a, axis=(0, 2)), x.sum((0, 2)), rtol=1e-5)
+    assert_almost_equal(mx.nd.sum(a, axis=1, exclude=True), x.sum((0, 2)), rtol=1e-5)
+    assert_almost_equal(mx.nd.mean(a, axis=1, keepdims=True),
+                        x.mean(1, keepdims=True), rtol=1e-5)
+    assert_almost_equal(mx.nd.max(a, axis=2), x.max(2))
+    assert_almost_equal(mx.nd.min(a), x.min())
+    assert_almost_equal(mx.nd.prod(a, axis=0), x.prod(0), rtol=1e-5)
+    assert_almost_equal(mx.nd.argmax(a, axis=1),
+                        x.argmax(1).astype(np.float32))
+    assert_almost_equal(mx.nd.norm(a), np.sqrt((x ** 2).sum()), rtol=1e-5)
+
+
+def test_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.dot(mx.nd.array(a), mx.nd.array(b)), a @ b,
+                        rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a), mx.nd.array(b.T), transpose_b=True),
+        a @ b, rtol=1e-5)
+    assert_almost_equal(
+        mx.nd.dot(mx.nd.array(a.T), mx.nd.array(b), transpose_a=True),
+        a @ b, rtol=1e-5)
+    # batch dot
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(mx.nd.batch_dot(mx.nd.array(x), mx.nd.array(y)),
+                        np.matmul(x, y), rtol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    w = np.random.rand(5, 12).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+                               num_hidden=5)
+    expected = x.reshape(2, 12) @ w.T + b
+    assert_almost_equal(out, expected, rtol=1e-5)
+    out_nf = mx.nd.FullyConnected(mx.nd.array(x),
+                                  mx.nd.array(np.random.rand(5, 4).astype(np.float32)),
+                                  None, num_hidden=5, no_bias=True,
+                                  flatten=False)
+    assert out_nf.shape == (2, 3, 5)
+
+
+def test_convolution():
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                            kernel=(3, 3), num_filter=3, no_bias=True,
+                            pad=(1, 1))
+    assert out.shape == (1, 3, 5, 5)
+    # check center value against direct correlation
+    ref = np.zeros((1, 3, 5, 5), np.float32)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    for f in range(3):
+        for i in range(5):
+            for j in range(5):
+                ref[0, f, i, j] = (xp[0, :, i:i + 3, j:j + 3] * w[f]).sum()
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pooling():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max")
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    out_avg = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type="avg")
+    ref_avg = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(out_avg, ref_avg, rtol=1e-5)
+    out_g = mx.nd.Pooling(mx.nd.array(x), global_pool=True, pool_type="max",
+                          kernel=(1, 1))
+    assert out_g.shape == (1, 1, 1, 1)
+
+
+def test_softmax():
+    x = np.random.rand(3, 5).astype(np.float32)
+    out = mx.nd.softmax(mx.nd.array(x))
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lout = mx.nd.log_softmax(mx.nd.array(x))
+    assert_almost_equal(lout, np.log(e / e.sum(-1, keepdims=True)), rtol=1e-5)
+
+
+def test_batchnorm_inference():
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    mean = np.random.rand(3).astype(np.float32)
+    var = np.random.rand(3).astype(np.float32) + 0.5
+    out = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(gamma),
+                          mx.nd.array(beta), mx.nd.array(mean),
+                          mx.nd.array(var), fix_gamma=False, eps=1e-5)
+    ref = (x - mean[None, :, None, None]) / np.sqrt(var[None, :, None, None] + 1e-5) \
+        * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm():
+    x = np.random.rand(2, 5).astype(np.float32)
+    g = np.ones(5, np.float32)
+    b = np.zeros(5, np.float32)
+    out = mx.nd.LayerNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b))
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    assert_almost_equal(out, (x - mean) / np.sqrt(var + 1e-5), rtol=1e-4)
+
+
+def test_shape_ops():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = mx.nd.array(x)
+    assert_almost_equal(mx.nd.transpose(a, axes=(2, 0, 1)),
+                        x.transpose(2, 0, 1))
+    assert_almost_equal(mx.nd.swapaxes(a, 0, 2), x.swapaxes(0, 2))
+    assert_almost_equal(mx.nd.flip(a, axis=1), np.flip(x, 1))
+    assert_almost_equal(mx.nd.tile(a, reps=(1, 2, 1)), np.tile(x, (1, 2, 1)))
+    assert_almost_equal(mx.nd.repeat(a, repeats=2, axis=0), np.repeat(x, 2, 0))
+    assert_almost_equal(mx.nd.expand_dims(a, axis=1), x[:, None])
+    assert_almost_equal(mx.nd.slice(a, begin=(0, 1), end=(2, 3)), x[0:2, 1:3])
+    assert_almost_equal(mx.nd.slice_axis(a, axis=2, begin=1, end=3),
+                        x[:, :, 1:3])
+    assert_almost_equal(mx.nd.broadcast_to(mx.nd.array(x[:1]), shape=(5, 3, 4)),
+                        np.broadcast_to(x[:1], (5, 3, 4)))
+    assert_almost_equal(mx.nd.pad(a, mode="constant",
+                                  pad_width=(0, 0, 0, 0, 1, 1),
+                                  constant_value=0),
+                        np.pad(x, ((0, 0), (0, 0), (1, 1))))
+
+
+def test_take_gather():
+    x = np.random.rand(5, 3).astype(np.float32)
+    idx = np.array([0, 4, 2], np.float32)
+    assert_almost_equal(mx.nd.take(mx.nd.array(x), mx.nd.array(idx)),
+                        x[idx.astype(int)])
+    # Embedding
+    w = np.random.rand(10, 4).astype(np.float32)
+    data = np.array([[1, 2], [3, 4]], np.float32)
+    out = mx.nd.Embedding(mx.nd.array(data), mx.nd.array(w), input_dim=10,
+                          output_dim=4)
+    assert_almost_equal(out, w[data.astype(int)])
+    # one_hot
+    oh = mx.nd.one_hot(mx.nd.array([1.0, 0.0, 2.0]), depth=3)
+    assert_almost_equal(oh, np.eye(3, dtype=np.float32)[[1, 0, 2]])
+    # pick
+    p = mx.nd.pick(mx.nd.array(x), mx.nd.array(np.array([0, 1, 2, 0, 1], np.float32)), axis=1)
+    assert_almost_equal(p, x[np.arange(5), [0, 1, 2, 0, 1]])
+
+
+def test_topk_sort():
+    x = np.random.rand(3, 6).astype(np.float32)
+    a = mx.nd.array(x)
+    vals = mx.nd.topk(a, k=2, ret_typ="value")
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :2]
+    assert_almost_equal(vals, ref)
+    assert_almost_equal(mx.nd.sort(a), np.sort(x, -1))
+    idx = mx.nd.argsort(a).asnumpy().astype(int)
+    assert_almost_equal(np.take_along_axis(x, idx, -1), np.sort(x, -1))
+
+
+def test_where_clip():
+    x = np.random.uniform(-1, 1, (3, 3)).astype(np.float32)
+    cond = (x > 0).astype(np.float32)
+    out = mx.nd.where(mx.nd.array(cond), mx.nd.array(x), mx.nd.array(-x))
+    assert_almost_equal(out, np.abs(x))
+    assert_almost_equal(mx.nd.clip(mx.nd.array(x), a_min=-0.5, a_max=0.5),
+                        np.clip(x, -0.5, 0.5))
+
+
+def test_split_concat():
+    x = np.random.rand(4, 6).astype(np.float32)
+    parts = mx.nd.split(mx.nd.array(x), num_outputs=3, axis=1)
+    assert len(parts) == 3
+    for i, p in enumerate(parts):
+        assert_almost_equal(p, x[:, 2 * i:2 * i + 2])
+    back = mx.nd.concat(*parts, dim=1)
+    assert_almost_equal(back, x)
+
+
+# ---- gradient checks (central difference vs tape) -------------------------
+
+
+def test_grad_elemwise():
+    x = np.random.uniform(0.5, 1.5, (2, 3)).astype(np.float32)
+    check_numeric_gradient(lambda a: (a * a + mx.nd.exp(a)).sum(), [x])
+
+
+def test_grad_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    check_numeric_gradient(lambda x, y: mx.nd.dot(x, y).sum(), [a, b])
+
+
+def test_grad_softmax_ce():
+    x = np.random.rand(2, 4).astype(np.float32)
+
+    def fn(a):
+        return -(mx.nd.log_softmax(a) * mx.nd.one_hot(
+            mx.nd.array([1.0, 3.0]), depth=4)).sum()
+
+    check_numeric_gradient(fn, [x], rtol=2e-2, atol=1e-3)
+
+
+def test_grad_conv():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 1, 3, 3).astype(np.float32)
+
+    def fn(a, b):
+        return mx.nd.Convolution(a, b, None, kernel=(3, 3), num_filter=2,
+                                 no_bias=True, pad=(1, 1)).sum()
+
+    check_numeric_gradient(fn, [x, w], rtol=2e-2, atol=1e-2)
+
+
+def test_rnn_op_shapes():
+    T, N, C, H, L = 4, 2, 3, 5, 2
+    ngates = 4
+    sizes = 0
+    for layer in range(L):
+        inc = C if layer == 0 else H
+        sizes += ngates * H * inc + ngates * H * H + 2 * ngates * H
+    params = mx.nd.random.normal(shape=(sizes,), scale=0.1)
+    data = mx.nd.random.normal(shape=(T, N, C))
+    h0 = mx.nd.zeros((L, N, H))
+    c0 = mx.nd.zeros((L, N, H))
+    out, hN, cN = mx.nd.RNN(data, params, h0, c0, state_size=H,
+                            num_layers=L, mode="lstm")
+    assert out.shape == (T, N, H)
+    assert hN.shape == (L, N, H)
+    assert cN.shape == (L, N, H)
+
+
+def test_ctc_loss_smoke():
+    T, N, C = 10, 2, 5
+    pred = mx.nd.random.normal(shape=(T, N, C))
+    label = mx.nd.array(np.array([[1, 2, 0], [2, 3, 4]], np.float32))
+    from mxnet_tpu.ops.dispatch import invoke
+
+    loss = invoke("_ctc_loss", pred, label)
+    assert loss.shape == (N,)
+    assert (loss.asnumpy() > 0).all()
