@@ -47,15 +47,23 @@ def main():
         x = x.astype(dtype)
     y = mx.nd.array(np.random.randint(0, 10, (batch,)).astype(np.float32))
 
+    def hard_sync(val):
+        # NB: block_until_ready does not synchronize through the axon
+        # remote-execution relay; a dependent host read does.
+        arr = np.asarray(val.data if hasattr(val, "data") else val)
+        p0 = step._state[0][0]
+        _ = np.asarray(p0).ravel()[0]
+        return float(arr)
+
     # warmup (compile)
     for _ in range(3):
         loss = step(x, y, lr=0.05, sync=False)
-    jax.block_until_ready(loss)
+    hard_sync(loss)
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = step(x, y, lr=0.05, sync=False)
-    jax.block_until_ready(loss)
+    hard_sync(loss)
     dt = time.perf_counter() - t0
 
     img_s = batch * steps / dt

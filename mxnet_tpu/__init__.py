@@ -50,4 +50,15 @@ from . import module  # noqa: F401
 from . import monitor  # noqa: F401
 from . import visualization  # noqa: F401
 from . import parallel  # noqa: F401
+from . import operator  # noqa: F401
 from .util import is_np_array, set_np, reset_np  # noqa: F401
+from . import numpy  # noqa: F401
+from . import numpy as np  # noqa: F401
+from . import numpy_extension  # noqa: F401
+from . import numpy_extension as npx  # noqa: F401
+from . import amp  # noqa: F401
+from . import engine  # noqa: F401
+from . import attribute  # noqa: F401
+from .attribute import AttrScope  # noqa: F401
+from . import rtc  # noqa: F401
+from .module import module as mod  # noqa: F401

@@ -1,0 +1,129 @@
+"""Automatic Mixed Precision (reference: ``python/mxnet/contrib/amp/``).
+
+TPU-native: bf16 is the native mixed-precision dtype — no loss scaling is
+required (bf16 has fp32's exponent range), so the reference's dynamic
+loss-scaler machinery collapses to a near-no-op policy (SURVEY.md §7 S5:
+"amp.init() becomes near-no-op policy setting"). The fp16 path keeps a
+dynamic scaler for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+_STATE = {"target_dtype": None}
+
+# op families the reference forces to fp32 (lists/symbol_fp16.py):
+# reductions, softmax/norm/exp-type ops stay fp32 — XLA handles this per-op
+# via dtype promotion; the cast policy below applies at block boundaries.
+FP32_OPS = ("softmax", "log_softmax", "norm", "mean", "sum", "BatchNorm",
+            "LayerNorm")
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP. On TPU prefer bfloat16 (default)."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    _STATE["target_dtype"] = target_dtype
+
+
+def is_enabled():
+    return _STATE["target_dtype"] is not None
+
+
+def target_dtype():
+    return _STATE["target_dtype"]
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler for fp16; no-op for bf16."""
+    if _STATE["target_dtype"] == "float16":
+        trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+def convert_model(net, target_dtype=None):
+    """Cast a Gluon block to the AMP dtype, keeping norm-layer statistics
+    in fp32 (``BatchNorm.cast`` pins them)."""
+    dtype = target_dtype or _STATE["target_dtype"] or "bfloat16"
+    net.cast(dtype)
+    return net
+
+
+convert_hybrid_block = convert_model
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: ``loss_scaler.py``). Needed only
+    for fp16; bf16 trains unscaled."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        import numpy as np
+
+        for p in params:
+            g = p.grad() if hasattr(p, "grad") else p
+            if g is None:
+                continue
+            a = g.asnumpy()
+            if not np.isfinite(a).all():
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``"""
+
+    def __init__(self, loss, trainer):
+        self._loss = loss
+        self._trainer = trainer
+        self._scaler = getattr(trainer, "_amp_loss_scaler", None)
+
+    def __enter__(self):
+        if self._scaler is None:
+            return self._loss
+        scale = self._scaler.loss_scale
+        if isinstance(self._loss, (list, tuple)):
+            return [l * scale for l in self._loss]
+        return self._loss * scale
+
+    def __exit__(self, *exc):
+        if self._scaler is not None:
+            params = [p for p in self._trainer._params if p.grad_req != "null"]
+            overflow = self._scaler.has_overflow(params)
+            if not overflow:
+                # unscale with the SAME factor the loss was multiplied by,
+                # before the scaler adjusts it for the next step
+                inv = 1.0 / self._scaler.loss_scale
+                for p in params:
+                    for g in p.list_grad():
+                        g._set_data(g.data * inv)
+            else:  # skip step by zeroing grads
+                for p in params:
+                    p.zero_grad()
+            self._scaler.update_scale(overflow)
+        return False
+
+
+def unscale(trainer):
+    pass

@@ -1,0 +1,33 @@
+"""Engine control surface (reference: ``python/mxnet/engine.py`` over
+``src/engine/``).
+
+TPU-native: JAX async dispatch replaces the dependency engine; these
+entry points keep the API (bulking is XLA fusion — free; NaiveEngine's
+synchronous-debug role maps to ``MXTPU_SYNC_EXEC=1``, which blocks after
+every op dispatch — SURVEY.md §5.2)."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+_BULK = {"size": 15}
+
+
+def set_bulk_size(size):
+    prev, _BULK["size"] = _BULK["size"], size
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)
+
+
+def sync_exec_enabled() -> bool:
+    """NaiveEngine analog: MXTPU_SYNC_EXEC=1 -> block after every op."""
+    return os.environ.get("MXTPU_SYNC_EXEC", "0") == "1"

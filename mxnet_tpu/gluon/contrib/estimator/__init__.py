@@ -1,0 +1,17 @@
+"""``gluon.contrib.estimator`` (reference: 1.6 train-loop abstraction)."""
+
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    TrainBegin,
+    TrainEnd,
+    EpochBegin,
+    EpochEnd,
+    BatchBegin,
+    BatchEnd,
+    StoppingHandler,
+    MetricHandler,
+    ValidationHandler,
+    LoggingHandler,
+    CheckpointHandler,
+    EarlyStoppingHandler,
+)

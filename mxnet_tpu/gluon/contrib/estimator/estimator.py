@@ -1,0 +1,107 @@
+"""Estimator train loop (reference: ``estimator/estimator.py``)."""
+
+from __future__ import annotations
+
+from .... import metric as _metric
+from ....base import MXNetError
+from ... import Trainer
+from ....ndarray.ndarray import NDArray
+from .event_handler import (
+    BatchBegin,
+    BatchEnd,
+    EpochBegin,
+    EpochEnd,
+    LoggingHandler,
+    MetricHandler,
+    StoppingHandler,
+    TrainBegin,
+    TrainEnd,
+)
+
+
+class Estimator:
+    def __init__(self, net, loss, metrics=None, initializer=None,
+                 trainer=None, context=None):
+        from .... import autograd
+
+        self.net = net
+        self.loss = loss
+        if metrics is None:
+            self.train_metrics = [_metric.Accuracy()]
+        elif isinstance(metrics, (list, tuple)):
+            self.train_metrics = list(metrics)  # copy: never mutate caller's
+        else:
+            self.train_metrics = [metrics]
+        self.train_metrics.append(_metric.Loss("loss"))
+        if initializer is not None:
+            net.initialize(init=initializer)
+        self.trainer = trainer or Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.01})
+        self._autograd = autograd
+
+    def evaluate(self, val_data, val_metrics=None):
+        if val_metrics is None:
+            # fresh instances: never clobber the in-flight training metrics
+            if not hasattr(self, "_val_metrics"):
+                self._val_metrics = [_metric.Accuracy("val_accuracy"),
+                                     _metric.Loss("val_loss")]
+            val_metrics = self._val_metrics
+        for m in val_metrics:
+            m.reset()
+        for batch in val_data:
+            x, y = self._unpack(batch)
+            pred = self.net(x)
+            loss = self.loss(pred, y)
+            for m in val_metrics:
+                if isinstance(m, _metric.Loss):
+                    m.update(0, loss)
+                else:
+                    m.update(y, pred)
+        return val_metrics
+
+    def _unpack(self, batch):
+        if isinstance(batch, (list, tuple)):
+            return batch[0], batch[1]
+        return batch.data[0], batch.label[0]
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError("specify epochs or batches")
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        def should_stop():
+            return any(getattr(h, "stop_training", False) for h in handlers)
+
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(self)
+        while not should_stop():
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(self)
+            for batch in train_data:
+                x, y = self._unpack(batch)
+                for h in handlers:
+                    if isinstance(h, BatchBegin):
+                        h.batch_begin(self, batch=batch)
+                with self._autograd.record():
+                    pred = self.net(x)
+                    loss = self.loss(pred, y)
+                loss.backward()
+                self.trainer.step(x.shape[0])
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        h.batch_end(self, batch=batch, pred=pred, label=y,
+                                    loss=loss)
+                if should_stop():
+                    break
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    h.epoch_end(self)
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(self)

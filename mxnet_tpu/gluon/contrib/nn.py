@@ -1,0 +1,73 @@
+"""Contrib layers (reference: ``gluon/contrib/nn/basic_layers.py``)."""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.basic_layers import HybridSequential, Sequential, SyncBatchNorm  # noqa: F401
+
+
+class Concurrent(Sequential):
+    """Parallel branches, outputs concatenated (reference: ``Concurrent``)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ...ndarray import op as F
+
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class HybridConcurrent(HybridBlock):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[block(x) for block in self._children.values()],
+                        dim=self.axis)
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.identity(x)
+
+
+class SparseEmbedding(HybridBlock):
+    """Embedding with row_sparse gradients (reference: ``SparseEmbedding``).
+
+    On TPU the gradient is dense in HBM but the optimizer update touches
+    only the gathered rows when used with the sparse-aware trainer path.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._kwargs = {"input_dim": input_dim, "output_dim": output_dim,
+                        "dtype": dtype, "sparse_grad": True}
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype="row_sparse")
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, **self._kwargs)
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._factor = (factor, factor) if isinstance(factor, int) else tuple(factor)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._factor
+        n, c, h, w = x.shape
+        x = F.reshape(x, shape=(n, c // (f1 * f2), f1, f2, h, w))
+        x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
+        return F.reshape(x, shape=(n, c // (f1 * f2), h * f1, w * f2))

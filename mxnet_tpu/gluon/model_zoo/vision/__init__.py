@@ -13,6 +13,23 @@ from .resnet import (  # noqa: F401
     BasicBlockV1, BasicBlockV2, BottleneckV1, BottleneckV2,
 )
 
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .vgg import (  # noqa: F401
+    VGG, get_vgg,
+    vgg11, vgg13, vgg16, vgg19,
+    vgg11_bn, vgg13_bn, vgg16_bn, vgg19_bn,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
+from .mobilenet import (  # noqa: F401
+    MobileNet, MobileNetV2, get_mobilenet, get_mobilenet_v2,
+    mobilenet1_0, mobilenet0_75, mobilenet0_5, mobilenet0_25,
+    mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5, mobilenet_v2_0_25,
+)
+from .inception import Inception3, inception_v3  # noqa: F401
+
 _models = {
     "resnet18_v1": resnet18_v1,
     "resnet34_v1": resnet34_v1,
@@ -24,6 +41,19 @@ _models = {
     "resnet50_v2": resnet50_v2,
     "resnet101_v2": resnet101_v2,
     "resnet152_v2": resnet152_v2,
+    "alexnet": alexnet,
+    "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+    "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn,
+    "vgg16_bn": vgg16_bn, "vgg19_bn": vgg19_bn,
+    "squeezenet1.0": squeezenet1_0,
+    "squeezenet1.1": squeezenet1_1,
+    "densenet121": densenet121, "densenet161": densenet161,
+    "densenet169": densenet169, "densenet201": densenet201,
+    "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+    "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+    "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+    "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    "inceptionv3": inception_v3,
 }
 
 

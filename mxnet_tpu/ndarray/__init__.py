@@ -26,6 +26,14 @@ from .ndarray import (  # noqa: F401
 from . import op  # noqa: F401
 from .op import *  # noqa: F401,F403
 from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from . import contrib  # noqa: F401
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    from ..operator import Custom as _custom
+
+    return _custom(*inputs, op_type=op_type, **kwargs)
 
 # ---------------------------------------------------------------------------
 # method attachment (reference: NDArray methods generated over the same ops)

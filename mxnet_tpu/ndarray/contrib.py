@@ -1,0 +1,206 @@
+"""``mx.nd.contrib`` — contrib ops + control-flow operators.
+
+Reference: ``python/mxnet/ndarray/contrib.py`` (symbols ``foreach``,
+``while_loop``, ``cond``) over ``src/operator/control_flow.cc``.
+
+TPU-native: the control-flow ops execute eagerly as Python loops (same
+observable semantics as the reference's imperative path); inside a
+hybridized trace they lower to ``lax.scan`` / ``lax.while_loop`` /
+``lax.cond`` so compiled graphs stay compiled (SURVEY.md §2.2
+'control_flow.cc' -> "natural fit").
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+from .ndarray import NDArray
+from . import op as _op
+
+_THIS = sys.modules[__name__]
+
+# re-export every _contrib_* alias under its short name
+for _name in list(_registry.all_ops()):
+    if _name.startswith("_contrib_"):
+        short = _name[len("_contrib_"):]
+        setattr(_THIS, short, getattr(_op, _name))
+for _extra in ("box_nms", "box_iou", "boolean_mask", "arange_like",
+               "div_sqrt_dim", "index_copy", "index_array", "allclose",
+               "quantize_2bit", "ROIAlign", "MultiBoxPrior",
+               "BilinearResize2D", "AdaptiveAvgPooling2D",
+               "interleaved_matmul_selfatt_qk",
+               "interleaved_matmul_selfatt_valatt", "gradientmultiplier"):
+    if not hasattr(_THIS, _extra):
+        setattr(_THIS, _extra, getattr(_op, _extra))
+
+
+def _in_trace():
+    from ..gluon.block import _in_cached_trace
+
+    return _in_cached_trace()
+
+
+def foreach(body, data, init_states, name=""):
+    """Scan ``body`` over axis 0 (reference: ``control_flow.cc:foreach``).
+
+    body(item, states) -> (output, new_states)
+    """
+    single_data = isinstance(data, NDArray)
+    datas = [data] if single_data else list(data)
+    single_state = isinstance(init_states, NDArray)
+    states = [init_states] if single_state else list(init_states)
+
+    if _in_trace():
+        def scan_fn(carry, xs):
+            st = [NDArray(c) for c in carry]
+            items = [NDArray(x) for x in xs]
+            out, new_st = body(items[0] if single_data else items,
+                               st[0] if single_state else st)
+            outs = [out] if isinstance(out, NDArray) else list(out)
+            new_states = [new_st] if isinstance(new_st, NDArray) else list(new_st)
+            return [s.data for s in new_states], [o.data for o in outs]
+
+        carry, ys = jax.lax.scan(scan_fn, [s.data for s in states],
+                                 [d.data for d in datas])
+        outs = [NDArray(y) for y in ys]
+        final = [NDArray(c) for c in carry]
+    else:
+        length = datas[0].shape[0]
+        outputs = []
+        cur = states
+        for i in range(length):
+            items = [d[i] for d in datas]
+            out, new_st = body(items[0] if single_data else items,
+                               cur[0] if single_state else cur)
+            outputs.append([out] if isinstance(out, NDArray) else list(out))
+            cur = [new_st] if isinstance(new_st, NDArray) else list(new_st)
+        outs = [
+            NDArray(jnp.stack([o[k].data for o in outputs]))
+            for k in range(len(outputs[0]))
+        ]
+        final = cur
+    out_res = outs[0] if len(outs) == 1 else outs
+    state_res = final[0] if single_state else final
+    return out_res, state_res
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None, name=""):
+    """Reference: ``control_flow.cc:while_loop``. Eager path loops in
+    Python; outputs are stacked and padded to ``max_iterations`` rows
+    (the reference's fixed-shape output contract)."""
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    single = isinstance(loop_vars, NDArray)
+    cur = [loop_vars] if single else list(loop_vars)
+
+    if _in_trace():
+        return _while_loop_traced(cond, func, cur, single, max_iterations)
+    outputs = []
+    steps = 0
+    while steps < max_iterations and bool(cond(*cur)):
+        res = func(*cur)
+        if isinstance(res, tuple) and len(res) == 2:
+            step_out, new_vars = res
+        else:
+            step_out, new_vars = res, res
+        outputs.append([step_out] if isinstance(step_out, NDArray)
+                       else list(step_out))
+        cur = [new_vars] if isinstance(new_vars, NDArray) else list(new_vars)
+        steps += 1
+    if outputs:
+        stacked = []
+        for k in range(len(outputs[0])):
+            rows = jnp.stack([o[k].data for o in outputs])
+            pad = max_iterations - rows.shape[0]
+            if pad > 0:
+                rows = jnp.concatenate(
+                    [rows, jnp.zeros((pad,) + rows.shape[1:], rows.dtype)])
+            stacked.append(NDArray(rows))
+        outs = stacked[0] if len(stacked) == 1 else stacked
+    else:
+        outs = []
+    return outs, (cur[0] if single else cur)
+
+
+def _while_loop_traced(cond, func, cur, single, max_iterations):
+    """Trace-mode while_loop: a masked lax.scan over max_iterations so the
+    per-step outputs keep the reference's fixed (max_iterations, ...) shape."""
+
+    def probe():
+        out = func(*cur)
+        if isinstance(out, tuple) and len(out) == 2:
+            step_out, _ = out
+        else:
+            step_out = out
+        outs = [step_out] if isinstance(step_out, NDArray) else list(step_out)
+        return [(o.shape, o.data.dtype) for o in outs]
+
+    out_spec = probe()
+
+    def scan_fn(carry, _):
+        active, vars_raw = carry
+        vs = [NDArray(v) for v in vars_raw]
+        pred = cond(*vs)
+        pred_raw = pred.data.astype(bool).reshape(()) if isinstance(pred, NDArray) \
+            else jnp.asarray(pred, bool).reshape(())
+        run = active & pred_raw
+        res = func(*vs)
+        if isinstance(res, tuple) and len(res) == 2:
+            step_out, new_vars = res
+        else:
+            step_out, new_vars = res, res
+        outs = [step_out] if isinstance(step_out, NDArray) else list(step_out)
+        news = [new_vars] if isinstance(new_vars, NDArray) else list(new_vars)
+        next_vars = [jnp.where(run, n.data, v)
+                     for n, v in zip(news, vars_raw)]
+        ys = [jnp.where(run, o.data, jnp.zeros(s, d))
+              for o, (s, d) in zip(outs, out_spec)]
+        return (run & True, next_vars), ys
+
+    (_, final_raw), ys = jax.lax.scan(
+        scan_fn, (jnp.asarray(True), [v.data for v in cur]),
+        None, length=max_iterations)
+    stacked = [NDArray(y) for y in ys]
+    outs = stacked[0] if len(stacked) == 1 else stacked
+    final = [NDArray(v) for v in final_raw]
+    return outs, (final[0] if single else final)
+
+
+def cond(pred, then_func, else_func, name=""):
+    """Reference: ``control_flow.cc:cond``."""
+    if _in_trace():
+        p = pred() if callable(pred) else pred
+        p_raw = p.data if isinstance(p, NDArray) else jnp.asarray(p)
+
+        def wrap(fn):
+            def inner(_):
+                out = fn()
+                outs = [out] if isinstance(out, NDArray) else list(out)
+                return [o.data for o in outs]
+
+            return inner
+
+        res = jax.lax.cond(p_raw.astype(bool).reshape(()), wrap(then_func),
+                           wrap(else_func), operand=None)
+        outs = [NDArray(r) for r in res]
+        return outs[0] if len(outs) == 1 else outs
+    p = pred() if callable(pred) else pred
+    take_then = bool(p.asnumpy().reshape(-1)[0]) if isinstance(p, NDArray) else bool(p)
+    return then_func() if take_then else else_func()
+
+
+def isfinite(data):
+    return _op.isfinite(data)
+
+
+def isnan(data):
+    return _op.isnan(data)
+
+
+def isinf(data):
+    return _op.isinf(data)

@@ -1,0 +1,140 @@
+"""``mx.np`` — NumPy-compatible array API (reference: ``python/mxnet/numpy/``,
+1.6+ ``mx.np`` namespace, SURVEY.md §2.4).
+
+TPU-native: thin wrappers over ``jax.numpy`` returning framework NDArrays,
+so ``mx.np`` arrays interoperate with Gluon/autograd exactly like ``mx.nd``
+arrays (they are the same handle type)."""
+
+from __future__ import annotations
+
+import sys
+
+import numpy as _onp
+
+import jax.numpy as jnp
+
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+
+ndarray = NDArray
+_THIS = sys.modules[__name__]
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x.data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(i) for i in x)
+    return x
+
+
+def _wrap(r):
+    import jax
+
+    if isinstance(r, jax.Array):
+        return NDArray(r, ctx=current_context())
+    if isinstance(r, tuple) and hasattr(r, "_fields"):  # namedtuple results
+        return type(r)(*(_wrap(i) for i in r))
+    if isinstance(r, (list, tuple)):
+        return type(r)(_wrap(i) for i in r)
+    return r
+
+
+def _make(jfn, name):
+    def f(*args, **kwargs):
+        args = tuple(_unwrap(a) for a in args)
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        return _wrap(jfn(*args, **kwargs))
+
+    f.__name__ = name
+    f.__doc__ = getattr(jfn, "__doc__", None)
+    return f
+
+
+_FUNCS = [
+    # creation
+    "array", "zeros", "ones", "full", "empty", "arange", "linspace",
+    "logspace", "eye", "identity", "zeros_like", "ones_like", "full_like",
+    "meshgrid", "tri", "tril", "triu", "diag", "diagonal", "indices",
+    # manipulation
+    "reshape", "ravel", "transpose", "moveaxis", "swapaxes", "expand_dims",
+    "squeeze", "concatenate", "stack", "vstack", "hstack", "dstack",
+    "column_stack", "split", "array_split", "hsplit", "vsplit", "dsplit",
+    "tile", "repeat", "flip", "fliplr", "flipud", "roll", "rot90", "pad",
+    "broadcast_to", "broadcast_arrays", "atleast_1d", "atleast_2d",
+    "atleast_3d", "append", "delete", "insert", "resize", "unique", "where",
+    "extract", "searchsorted", "sort", "argsort", "partition", "argpartition",
+    # math
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "power", "mod", "remainder", "fmod", "negative", "positive", "absolute",
+    "abs", "fabs", "sign", "rint", "floor", "ceil", "trunc", "around",
+    "round", "exp", "expm1", "exp2", "log", "log2", "log10", "log1p", "sqrt",
+    "cbrt", "square", "reciprocal", "sin", "cos", "tan", "arcsin", "arccos",
+    "arctan", "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh",
+    "arctanh", "degrees", "radians", "deg2rad", "rad2deg", "hypot", "maximum",
+    "minimum", "fmax", "fmin", "clip", "nan_to_num", "interp", "heaviside",
+    "gcd", "lcm", "ldexp", "signbit", "copysign", "nextafter",
+    # reductions
+    "sum", "prod", "cumsum", "cumprod", "nansum", "nanprod", "nancumsum",
+    "mean", "std", "var", "median", "average", "min", "max", "amin", "amax",
+    "nanmin", "nanmax", "nanmean", "nanstd", "nanvar", "ptp", "percentile",
+    "quantile", "argmin", "argmax", "nanargmin", "nanargmax", "count_nonzero",
+    "any", "all",
+    # linalg-ish
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum", "kron",
+    "trace", "cross",
+    # logic / comparison
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "isnan",
+    "isinf", "isfinite", "isposinf", "isneginf", "isclose", "allclose",
+    "array_equal", "array_equiv",
+    # indexing
+    "take", "take_along_axis", "choose", "compress", "diag_indices",
+    "tril_indices", "triu_indices", "nonzero", "flatnonzero", "argwhere",
+    "unravel_index", "ravel_multi_index",
+    # misc
+    "bincount", "histogram", "digitize", "corrcoef", "cov", "convolve",
+    "correlate", "gradient", "diff", "ediff1d", "trapezoid", "vander",
+    "polyval", "real", "imag", "conj", "conjugate", "angle",
+]
+
+for _n in _FUNCS:
+    if hasattr(jnp, _n) and not hasattr(_THIS, _n):
+        setattr(_THIS, _n, _make(getattr(jnp, _n), _n))
+
+# dtypes / constants re-exported
+pi = _onp.pi
+e = _onp.e
+euler_gamma = _onp.euler_gamma
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int16 = _onp.int16
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+dtype = _onp.dtype
+
+from . import linalg  # noqa: E402,F401
+from . import random  # noqa: E402,F401
+
+
+def asnumpy(a):
+    return a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+
+
+def shape(a):
+    return tuple(a.shape)
+
+
+def ndim(a):
+    return len(a.shape)
+
+
+def size(a):
+    return a.size if isinstance(a, NDArray) else _onp.size(a)

@@ -1,0 +1,18 @@
+"""``mx.np.linalg`` over ``jax.numpy.linalg``."""
+
+from __future__ import annotations
+
+import sys
+
+import jax.numpy.linalg as jla
+
+from . import _make
+
+_THIS = sys.modules[__name__]
+
+for _n in ("norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet",
+           "eig", "eigh", "eigvals", "eigvalsh", "solve", "lstsq",
+           "matrix_rank", "matrix_power", "tensorinv", "tensorsolve",
+           "multi_dot"):
+    if hasattr(jla, _n):
+        setattr(_THIS, _n, _make(getattr(jla, _n), _n))

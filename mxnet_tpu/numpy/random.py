@@ -1,0 +1,42 @@
+"""``mx.np.random`` — numpy-style names over the stateful key stream."""
+
+from __future__ import annotations
+
+from ..random import (  # noqa: F401
+    uniform,
+    normal,
+    randint,
+    gamma,
+    exponential,
+    multinomial,
+    shuffle,
+    seed,
+)
+
+
+def rand(*shape):
+    return uniform(0.0, 1.0, shape=shape or None)
+
+
+def randn(*shape):
+    return normal(0.0, 1.0, shape=shape or None)
+
+
+def choice(a, size=None, replace=True, p=None):
+    import jax
+
+    from .. import random as _r
+    from ..ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    if isinstance(a, NDArray):
+        arr = a.data
+    elif isinstance(a, int):
+        arr = jnp.arange(a)
+    else:
+        arr = jnp.asarray(a)
+    shape = (size,) if isinstance(size, int) else tuple(size or ())
+    idx = jax.random.choice(_r._next_key(), arr.shape[0], shape or (),
+                            replace=replace,
+                            p=None if p is None else jnp.asarray(p))
+    return NDArray(arr[idx])

@@ -1,0 +1,43 @@
+"""``mx.npx`` — NumPy-extension ops (reference: ``python/mxnet/numpy_extension``).
+
+Neural-network ops that have no NumPy equivalent, exposed over the shared
+op registry, plus ``set_np``/``reset_np``/``is_np_array``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ndarray import op as _op
+from ..util import is_np_array, is_np_shape, reset_np, set_np  # noqa: F401
+
+_THIS = sys.modules[__name__]
+
+_NPX_OPS = [
+    "relu", "sigmoid", "softmax", "log_softmax", "topk", "pick", "one_hot",
+    "Embedding", "FullyConnected", "Convolution", "Deconvolution", "Pooling",
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "Dropout", "RNN",
+    "arange_like", "sequence_mask", "reshape_like", "batch_dot",
+    "broadcast_like", "gather_nd", "LeakyReLU", "Activation",
+]
+
+for _n in _NPX_OPS:
+    if hasattr(_op, _n):
+        setattr(_THIS, _n, getattr(_op, _n))
+        low = _n[0].lower() + _n[1:] if _n[0].isupper() else _n
+        if not hasattr(_THIS, low):
+            setattr(_THIS, low, getattr(_op, _n))
+
+embedding = _op.Embedding
+fully_connected = _op.FullyConnected
+batch_norm = _op.BatchNorm
+layer_norm = _op.LayerNorm
+
+
+def seed(s):
+    from .. import random as _r
+
+    _r.seed(s)
+
+
+from ..context import cpu, gpu, num_gpus  # noqa: E402,F401

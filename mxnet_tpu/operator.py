@@ -1,0 +1,131 @@
+"""Custom operators defined in Python.
+
+Reference: ``python/mxnet/operator.py`` (symbols ``CustomOp``,
+``CustomOpProp``, ``operator.register``) over ``src/operator/custom/``.
+
+TPU-native: the reference calls Python back from engine threads (GIL
+dance); here custom ops run inline on the eager path and — when used
+inside a hybridized block — via ``jax.pure_callback`` so the compiled
+graph can still invoke Python (SURVEY.md §2.2 'custom/').
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+_CUSTOM_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for user ops. Subclass and implement forward/backward."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst._set_data(src.data if isinstance(src, NDArray) else jnp.asarray(src))
+        elif req == "add":
+            dst._set_data(dst.data + (src.data if isinstance(src, NDArray) else jnp.asarray(src)))
+        else:
+            raise MXNetError(f"invalid req {req}")
+
+
+class CustomOpProp:
+    """Describes a custom op (reference: ``CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    def deco(prop_cls):
+        _CUSTOM_REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get(reg_name):
+    return _CUSTOM_REGISTRY[reg_name]
+
+
+def invoke_custom(op_type, *inputs, **kwargs):
+    """Run a registered custom op eagerly (the ``mx.nd.Custom`` path)."""
+    if op_type not in _CUSTOM_REGISTRY:
+        raise MXNetError(f"custom op {op_type} not registered")
+    prop = _CUSTOM_REGISTRY[op_type](**kwargs)
+    in_shapes = [list(i.shape) for i in inputs]
+    in_shapes_res, out_shapes, aux_shapes = prop.infer_shape(in_shapes)
+    op = prop.create_operator(None, in_shapes_res, ["float32"] * len(inputs))
+    out_data = [NDArray(jnp.zeros(tuple(s), jnp.float32)) for s in out_shapes]
+    aux = [NDArray(jnp.zeros(tuple(s), jnp.float32)) for s in (aux_shapes or [])]
+
+    with autograd.pause():
+        op.forward(autograd.is_training(), ["write"] * len(out_data),
+                   list(inputs), out_data, aux)
+
+    if autograd.is_recording() and any(autograd.is_tracked(i) for i in inputs):
+        tracked = [i for i in inputs if autograd.is_tracked(i)]
+
+        def vjp_fn(out_ct):
+            cts = out_ct if isinstance(out_ct, (tuple, list)) else (out_ct,)
+            in_grad = [NDArray(jnp.zeros(i.shape, i.data.dtype)) for i in inputs]
+            with autograd.pause():
+                op.backward(["write"] * len(in_grad),
+                            [NDArray(c) for c in cts], list(inputs),
+                            out_data, in_grad, aux)
+            return [g.data for g, i in zip(in_grad, inputs)
+                    if autograd.is_tracked(i)]
+
+        node = autograd.TapeNode(vjp_fn, tracked, len(out_data),
+                                 name=f"Custom[{op_type}]")
+        node.out_arrays = out_data
+        for k, o in enumerate(out_data):
+            o._ag = (node, k)
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """``mx.nd.Custom(data, op_type='my_op')`` entry point."""
+    if op_type is None:
+        raise MXNetError("op_type is required")
+    return invoke_custom(op_type, *inputs, **kwargs)

@@ -1,0 +1,322 @@
+"""Contrib ops: detection/vision + transformer fusions.
+
+Reference: ``src/operator/contrib/`` (symbols ``box_nms``, ``ROIAlign``,
+``MultiBoxPrior``, ``BilinearResize2D``, ``AdaptiveAvgPooling2D``,
+``interleaved_matmul_selfatt_*``). Dynamic-shape ops (NMS, Proposal)
+use the TPU pad-to-max idiom (SURVEY.md §7.6): fixed-shape outputs with
+-1/invalid padding, exactly like the reference's ``box_nms`` output
+convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _iou(boxes_a, boxes_b, fmt="corner"):
+    if fmt == "center":
+        ax, ay, aw, ah = jnp.split(boxes_a, 4, axis=-1)
+        boxes_a = jnp.concatenate([ax - aw / 2, ay - ah / 2,
+                                   ax + aw / 2, ay + ah / 2], axis=-1)
+        bx, by, bw, bh = jnp.split(boxes_b, 4, axis=-1)
+        boxes_b = jnp.concatenate([bx - bw / 2, by - bh / 2,
+                                   bx + bw / 2, by + bh / 2], axis=-1)
+    al, at, ar, ab = jnp.split(boxes_a, 4, axis=-1)
+    bl, bt, br, bb = jnp.split(boxes_b, 4, axis=-1)
+    iw = jnp.maximum(0.0, jnp.minimum(ar, br.T) - jnp.maximum(al, bl.T))
+    ih = jnp.maximum(0.0, jnp.minimum(ab, bb.T) - jnp.maximum(at, bt.T))
+    inter = iw * ih
+    area_a = (ar - al) * (ab - at)
+    area_b = (br - bl) * (bb - bt)
+    return inter / jnp.maximum(area_a + area_b.T - inter, 1e-12)
+
+
+@register("box_iou", aliases=("_contrib_box_iou",))
+def box_iou(lhs, rhs, format="corner"):
+    return _iou(lhs.reshape(-1, 4), rhs.reshape(-1, 4), format).reshape(
+        lhs.shape[:-1] + rhs.shape[:-1]
+    )
+
+
+@register("box_nms", aliases=("_contrib_box_nms",))
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner", background_id=-1):
+    """Greedy NMS, fixed-shape: suppressed entries become all -1
+    (reference output convention). Runs as a fori_loop over candidates."""
+
+    def one_batch(boxes_scores):
+        n = boxes_scores.shape[0]
+        scores = boxes_scores[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(boxes_scores, coord_start, 4, axis=1)
+        ids = boxes_scores[:, id_index] if id_index >= 0 else jnp.zeros(n)
+        valid = scores > valid_thresh
+        if background_id >= 0 and id_index >= 0:
+            valid = valid & (ids != background_id)
+        order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+        boxes_sorted = boxes[order]
+        ious = _iou(boxes_sorted, boxes_sorted, in_format)
+        same_class = (ids[order][:, None] == ids[order][None, :]) \
+            if (not force_suppress and id_index >= 0) else jnp.ones((n, n), bool)
+
+        def body(i, keep):
+            sup = (ious[i] > overlap_thresh) & same_class[i] & keep[i]
+            sup = sup & (jnp.arange(n) > i)
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, n, body, valid[order])
+        if topk > 0:
+            rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+            keep = keep & (rank < topk)
+        out_sorted = jnp.where(keep[:, None], boxes_scores[order], -1.0)
+        return out_sorted
+
+    shape = data.shape
+    flat = data.reshape((-1,) + shape[-2:])
+    out = jax.vmap(one_batch)(flat)
+    return out.reshape(shape)
+
+
+@register("box_non_maximum_suppression")
+def box_non_maximum_suppression(data, **kwargs):
+    return box_nms(data, **kwargs)
+
+
+@register("MultiBoxPrior", aliases=("_contrib_MultiBoxPrior",))
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor box generation (reference: ``multibox_prior.cc``)."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), axis=-1)  # (h,w,2)
+    # anchors: sizes[0] with all ratios, then remaining sizes with ratios[0]
+    whs = []
+    for r in ratios:
+        sr = jnp.sqrt(r)
+        whs.append((sizes[0] * sr, sizes[0] / sr))
+    for s in sizes[1:]:
+        sr = jnp.sqrt(ratios[0])
+        whs.append((s * sr, s / sr))
+    whs = jnp.asarray(whs)  # (A, 2) in (w, h)
+    A = whs.shape[0]
+    cyx_b = jnp.broadcast_to(cyx[:, :, None, :], (h, w, A, 2))
+    half_w = whs[:, 0] / 2
+    half_h = whs[:, 1] / 2
+    xmin = cyx_b[..., 1] - half_w
+    ymin = cyx_b[..., 0] - half_h
+    xmax = cyx_b[..., 1] + half_w
+    ymax = cyx_b[..., 0] + half_h
+    anchors = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors.reshape(1, -1, 4)
+
+
+@register("ROIAlign", aliases=("_contrib_ROIAlign",))
+def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """RoIAlign with bilinear sampling (reference: ``roi_align.cc``)."""
+    N, C, H, W = data.shape
+    ph, pw = pooled_size
+    sr = sample_ratio if sample_ratio > 0 else 2
+    if position_sensitive:
+        # PS-RoIAlign (R-FCN): C = C_out * ph * pw; bin (i,j) of output
+        # channel c samples input channel c*ph*pw + i*pw + j
+        c_out = C // (ph * pw)
+        full = roi_align(data, rois, pooled_size, spatial_scale,
+                         sample_ratio, False, aligned)  # (n, C, ph, pw)
+        n = full.shape[0]
+        grouped = full.reshape(n, c_out, ph, pw, ph, pw)
+        ii = jnp.arange(ph)
+        jj = jnp.arange(pw)
+        # select the (i,j)-th channel-group at spatial bin (i,j)
+        return grouped[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+
+    def one_roi(roi):
+        batch_idx = roi[0].astype(jnp.int32)
+        offset = 0.5 if aligned else 0.0
+        x1 = roi[1] * spatial_scale - offset
+        y1 = roi[2] * spatial_scale - offset
+        x2 = roi[3] * spatial_scale - offset
+        y2 = roi[4] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        iy = (jnp.arange(ph)[:, None] * bin_h
+              + y1 + (jnp.arange(sr)[None, :] + 0.5) * bin_h / sr).reshape(-1)
+        ix = (jnp.arange(pw)[:, None] * bin_w
+              + x1 + (jnp.arange(sr)[None, :] + 0.5) * bin_w / sr).reshape(-1)
+        img = data[batch_idx]  # (C, H, W)
+
+        def bilinear(y, x):
+            y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, W - 1)
+            y1_ = jnp.clip(y0 + 1, 0, H - 1)
+            x1_ = jnp.clip(x0 + 1, 0, W - 1)
+            ly = jnp.clip(y - y0, 0.0, 1.0)
+            lx = jnp.clip(x - x0, 0.0, 1.0)
+            v = (img[:, y0, x0] * (1 - ly) * (1 - lx)
+                 + img[:, y1_, x0] * ly * (1 - lx)
+                 + img[:, y0, x1_] * (1 - ly) * lx
+                 + img[:, y1_, x1_] * ly * lx)
+            inside = (y >= -1) & (y <= H) & (x >= -1) & (x <= W)
+            return jnp.where(inside, v, 0.0)
+
+        yy, xx = jnp.meshgrid(iy, ix, indexing="ij")
+        samples = jax.vmap(jax.vmap(bilinear))(yy, xx)  # (phs, pws, C)
+        samples = samples.reshape(ph, sr, pw, sr, C)
+        return samples.mean(axis=(1, 3)).transpose(2, 0, 1)  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("BilinearResize2D", aliases=("_contrib_BilinearResize2D",))
+def bilinear_resize_2d(data, height=0, width=0, scale_height=None,
+                       scale_width=None, mode="size", align_corners=True):
+    N, C, H, W = data.shape
+    if height <= 0:
+        height = int(H * (scale_height or 1.0))
+    if width <= 0:
+        width = int(W * (scale_width or 1.0))
+    if not align_corners:
+        # half-pixel sampling == jax.image.resize 'linear'
+        return jax.image.resize(data, (N, C, height, width), method="linear")
+    # align_corners=True (the reference default): output corners map exactly
+    # onto input corners -> src = dst * (in-1)/(out-1)
+    ys = jnp.linspace(0.0, H - 1, height)
+    xs = jnp.linspace(0.0, W - 1, width)
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = (ys - y0).reshape(1, 1, -1, 1)
+    lx = (xs - x0).reshape(1, 1, 1, -1)
+    v00 = data[:, :, y0][:, :, :, x0]
+    v01 = data[:, :, y0][:, :, :, x1]
+    v10 = data[:, :, y1][:, :, :, x0]
+    v11 = data[:, :, y1][:, :, :, x1]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx).astype(data.dtype)
+
+
+@register("AdaptiveAvgPooling2D", aliases=("_contrib_AdaptiveAvgPooling2D",))
+def adaptive_avg_pooling_2d(data, output_size=(1, 1)):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    N, C, H, W = data.shape
+    oh, ow = output_size
+    if H % oh == 0 and W % ow == 0:
+        x = data.reshape(N, C, oh, H // oh, ow, W // ow)
+        return x.mean(axis=(3, 5))
+    return jax.image.resize(data, (N, C, oh, ow), method="linear")
+
+
+@register("allclose", aliases=("_contrib_allclose",))
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.asarray(
+        jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        dtype=jnp.float32,
+    ).reshape((1,))
+
+
+@register("index_copy", aliases=("_contrib_index_copy",))
+def index_copy(old_tensor, index_vector, new_tensor):
+    idx = index_vector.astype(jnp.int32)
+    return old_tensor.at[idx].set(new_tensor)
+
+
+@register("index_array", aliases=("_contrib_index_array",))
+def index_array(data, axes=None):
+    shape = data.shape
+    if axes is None:
+        axes = tuple(range(len(shape)))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in shape], indexing="ij")
+    sel = jnp.stack([grids[a] for a in axes], axis=-1)
+    return sel.astype(jnp.int64 if False else jnp.int32)
+
+
+@register("gradientmultiplier", aliases=("_contrib_gradientmultiplier",))
+def gradientmultiplier(data, scalar=1.0):
+    return _gradmult(data, scalar)
+
+
+@jax.custom_vjp
+def _gradmult(x, s):
+    return x
+
+
+def _gm_fwd(x, s):
+    return x, s
+
+
+def _gm_bwd(s, g):
+    return (g * s, None)
+
+
+_gradmult.defvjp(_gm_fwd, _gm_bwd)
+
+
+# ---- transformer fusions (reference: src/operator/contrib/transformer.cc) --
+
+
+@register("interleaved_matmul_selfatt_qk",
+          aliases=("_contrib_interleaved_matmul_selfatt_qk",))
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads=1):
+    """Input (T, N, 3*H*D) interleaved qkv; output (N*heads, T, T) scores."""
+    T, N, HD3 = queries_keys_values.shape
+    D = HD3 // (3 * heads)
+    qkv = queries_keys_values.reshape(T, N, heads, 3, D)
+    q = qkv[:, :, :, 0]  # (T, N, h, D)
+    k = qkv[:, :, :, 1]
+    q = q.transpose(1, 2, 0, 3).reshape(N * heads, T, D)
+    k = k.transpose(1, 2, 0, 3).reshape(N * heads, T, D)
+    scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
+    return jnp.einsum("btd,bsd->bts", q * scale, k)
+
+
+@register("interleaved_matmul_selfatt_valatt",
+          aliases=("_contrib_interleaved_matmul_selfatt_valatt",))
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads=1):
+    T, N, HD3 = queries_keys_values.shape
+    D = HD3 // (3 * heads)
+    qkv = queries_keys_values.reshape(T, N, heads, 3, D)
+    v = qkv[:, :, :, 2].transpose(1, 2, 0, 3).reshape(N * heads, T, D)
+    out = jnp.einsum("bts,bsd->btd", attention, v)  # (N*h, T, D)
+    return out.reshape(N, heads, T, D).transpose(2, 0, 1, 3).reshape(T, N, heads * D)
+
+
+@register("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))
+def div_sqrt_dim(data):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("arange_like", aliases=("_contrib_arange_like",))
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    if axis is None:
+        n = 1
+        for d in data.shape:
+            n *= d
+        r = start + step * jnp.arange(n, dtype=data.dtype)
+        return r.reshape(data.shape)
+    n = data.shape[axis]
+    return start + step * jnp.arange(n, dtype=data.dtype)
+
+
+@register("quantize_2bit")
+def quantize_2bit(grad, residual, threshold=0.5):
+    """2-bit gradient quantization (reference:
+    ``gradient_compression.cc:Quantize2BitImpl``): returns (quantized{-t,0,t},
+    new_residual). The wire format here is the dequantized tensor — on TPU
+    the win is the allreduce bandwidth, handled by the comm layer."""
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0))
+    return q, acc - q

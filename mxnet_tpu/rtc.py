@@ -1,0 +1,26 @@
+"""Runtime kernel compilation (reference: ``python/mxnet/rtc.py`` over
+``src/common/rtc.cc`` CUDA NVRTC).
+
+TPU-native: user runtime kernels are Pallas kernels, not CUDA C. The
+``CudaModule`` API raises with a pointer to the pallas path; see
+``mxnet_tpu.ops.pallas_kernels`` for the in-tree TPU kernels.
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+
+
+class CudaModule:
+    def __init__(self, source, options=(), exports=()):
+        raise MXNetError(
+            "CUDA RTC is not applicable on TPU. Write a Pallas kernel "
+            "instead (see mxnet_tpu/ops/pallas_kernels.py and "
+            "jax.experimental.pallas); XLA already fuses pointwise chains "
+            "that the reference needed RTC for."
+        )
+
+
+class CudaKernel:
+    def __init__(self, *a, **kw):
+        raise MXNetError("see CudaModule docstring: use Pallas on TPU")

@@ -1,0 +1,198 @@
+"""Contrib ops / control flow / custom op / AMP tests."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_box_iou():
+    a = mx.nd.array([[0.0, 0.0, 1.0, 1.0]])
+    b = mx.nd.array([[0.5, 0.5, 1.5, 1.5], [2.0, 2.0, 3.0, 3.0]])
+    iou = mx.nd.contrib.box_iou(a, b)
+    assert_almost_equal(iou, np.array([[0.25 / 1.75, 0.0]], np.float32),
+                        rtol=1e-4)
+
+
+def test_box_nms_suppression():
+    dets = mx.nd.array([[[0, 0.9, 0.1, 0.1, 0.5, 0.5],
+                         [0, 0.8, 0.12, 0.12, 0.52, 0.52],
+                         [0, 0.7, 0.6, 0.6, 0.9, 0.9]]])
+    out = mx.nd.contrib.box_nms(dets, overlap_thresh=0.5).asnumpy()[0]
+    assert out[0][1] == pytest.approx(0.9)      # best kept
+    assert (out[1] == -1).all()                 # overlapping suppressed
+    assert out[2][1] == pytest.approx(0.7)      # distant kept
+
+
+def test_multibox_prior():
+    x = mx.nd.zeros((1, 3, 4, 4))
+    anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                          ratios=(1, 2))
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    a = anchors.asnumpy()
+    assert (a[..., 2] >= a[..., 0]).all() and (a[..., 3] >= a[..., 1]).all()
+
+
+def test_roi_align():
+    data = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = mx.nd.array([[0, 0, 0, 3, 3]])
+    out = mx.nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                 spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    o = out.asnumpy()
+    assert o[0, 0, 0, 0] < o[0, 0, 1, 1]  # increasing ramp preserved
+
+
+def test_bilinear_resize():
+    x = mx.nd.array(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+    out = mx.nd.contrib.BilinearResize2D(x, height=4, width=4)
+    assert out.shape == (1, 1, 4, 4)
+
+
+def test_adaptive_avg_pool():
+    x = mx.nd.random.normal(shape=(2, 3, 8, 8))
+    out = mx.nd.contrib.AdaptiveAvgPooling2D(x, output_size=(2, 2))
+    assert out.shape == (2, 3, 2, 2)
+    assert_almost_equal(out,
+                        x.asnumpy().reshape(2, 3, 2, 4, 2, 4).mean((3, 5)),
+                        rtol=1e-5)
+
+
+def test_foreach_eager_and_hybrid():
+    def body(item, state):
+        return item * 2 + state, state + 1
+
+    data = mx.nd.array([1.0, 2.0, 3.0])
+    out, final = mx.nd.contrib.foreach(body, data, mx.nd.array([0.0]))
+    assert_almost_equal(out, np.array([[2], [5], [8]], np.float32))
+    assert_almost_equal(final, np.array([3.0], np.float32))
+
+    class ScanBlock(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            out, _ = mx.nd.contrib.foreach(body, x, mx.nd.zeros((1,)))
+            return out
+
+    blk = ScanBlock()
+    blk.initialize()
+    eager = blk(data).asnumpy()
+    blk.hybridize()
+    hybrid = blk(data).asnumpy()
+    np.testing.assert_allclose(eager, hybrid, rtol=1e-6)
+
+
+def test_while_loop():
+    def cond(i, s):
+        return i < 5
+
+    def func(i, s):
+        return (s, (i + 1, s + i))
+
+    outs, (i, s) = mx.nd.contrib.while_loop(
+        cond, func, (mx.nd.array([0.0]), mx.nd.array([0.0])),
+        max_iterations=10)
+    assert float(i.asscalar()) == 5
+    assert float(s.asscalar()) == 10  # 0+1+2+3+4
+
+
+def test_cond():
+    t = mx.nd.contrib.cond(lambda: mx.nd.array([1.0]),
+                           lambda: mx.nd.array([7.0]),
+                           lambda: mx.nd.array([9.0]))
+    assert float(t.asscalar()) == 7.0
+
+
+def test_custom_op_grad():
+    import mxnet_tpu.operator as operator
+
+    @operator.register("sq_custom")
+    class SquareProp(operator.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data, in_grad,
+                             aux):
+                    self.assign(in_grad[0], req[0],
+                                2 * in_data[0] * out_grad[0])
+
+            return Op()
+
+    x = mx.nd.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="sq_custom")
+    y.backward()
+    assert_almost_equal(y, np.array([4.0, 9.0], np.float32))
+    assert_almost_equal(x.grad, np.array([4.0, 6.0], np.float32))
+
+
+def test_np_namespace():
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, mx.NDArray)
+    assert_almost_equal(mx.np.mean(a), np.float32(2.5))
+    assert_almost_equal(mx.np.linalg.norm(a), np.linalg.norm([[1, 2], [3, 4]]),
+                        rtol=1e-5)
+    assert mx.np.arange(5).shape == (5,)
+    u, s, vt = mx.np.linalg.svd(a)
+    assert s.shape == (2,)
+    r = mx.np.random.rand(3, 2)
+    assert r.shape == (3, 2)
+
+
+def test_npx():
+    out = mx.npx.softmax(mx.nd.array([[1.0, 2.0, 3.0]]))
+    assert out.shape == (1, 3)
+    assert_almost_equal(out.sum(), np.float32(1.0), rtol=1e-5)
+
+
+def test_amp_bf16():
+    mx.amp._STATE["target_dtype"] = None
+    mx.amp.init(target_dtype="bfloat16")
+    assert mx.amp.is_enabled()
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    mx.amp.convert_model(net)
+    assert str(net.weight.data().dtype) == "bfloat16"
+    out = net(mx.nd.ones((2, 3)).astype("bfloat16"))
+    assert str(out.dtype) == "bfloat16"
+    mx.amp._STATE["target_dtype"] = None
+
+
+def test_amp_fp16_loss_scaler():
+    scaler = mx.amp.LossScaler(init_scale=4.0, scale_factor=2.0,
+                               scale_window=2)
+    scaler.update_scale(True)
+    assert scaler.loss_scale == 2.0
+    scaler.update_scale(False)
+    scaler.update_scale(False)
+    assert scaler.loss_scale == 4.0
+
+
+def test_gradientmultiplier():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.contrib.gradientmultiplier(x, scalar=3.0).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.array([3.0, 3.0], np.float32))
+
+
+def test_quantize_2bit():
+    g = mx.nd.array([0.7, -0.2, -0.9, 0.1])
+    r = mx.nd.zeros((4,))
+    q, new_r = mx.nd.contrib.quantize_2bit(g, r, threshold=0.5)
+    assert_almost_equal(q, np.array([0.5, 0.0, -0.5, 0.0], np.float32))
+    assert_almost_equal(new_r, np.array([0.2, -0.2, -0.4, 0.1], np.float32))
+
+
+def test_interleaved_selfatt():
+    T, N, H, D = 4, 2, 2, 8
+    qkv = mx.nd.random.normal(shape=(T, N, 3 * H * D))
+    att = mx.nd.contrib.interleaved_matmul_selfatt_qk(qkv, heads=H)
+    assert att.shape == (N * H, T, T)
+    probs = mx.nd.softmax(att, axis=-1)
+    out = mx.nd.contrib.interleaved_matmul_selfatt_valatt(qkv, probs, heads=H)
+    assert out.shape == (T, N, H * D)

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Pack an image dataset into RecordIO (reference: ``tools/im2rec.py``).
+
+Usage (same CLI as the reference):
+  python tools/im2rec.py prefix root --list        # make prefix.lst
+  python tools/im2rec.py prefix root               # pack prefix.rec/.idx
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def list_image(root, recursive, exts):
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and (suffix in exts):
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and (suffix in exts):
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        while True:
+            line = fin.readline()
+            if not line:
+                break
+            line = [i.strip() for i in line.strip().split("\t")]
+            line_len = len(line)
+            if line_len < 3:
+                continue
+            try:
+                item = [int(line[0])] + [line[-1]] + [float(i) for i in line[1:-1]]
+            except ValueError:
+                continue
+            yield item
+
+
+def image_encode(args, i, item, q_out):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.image import imdecode, imencode, imresize
+
+    fullpath = os.path.join(args.root, item[1])
+    if len(item) > 3 and args.pack_label:
+        header = recordio.IRHeader(0, item[2:], item[0], 0)
+    else:
+        header = recordio.IRHeader(0, item[2], item[0], 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as fin:
+            img = fin.read()
+        return recordio.pack(header, img)
+    with open(fullpath, "rb") as fin:
+        img = imdecode(fin.read(), to_rgb=False)
+    if args.resize:
+        h, w = img.shape[0], img.shape[1]
+        if h > w:
+            img = imresize(img, args.resize, int(h * args.resize / w))
+        else:
+            img = imresize(img, int(w * args.resize / h), args.resize)
+    buf = imencode(img, quality=args.quality, img_fmt=args.encoding)
+    return recordio.pack(header, buf)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description="Create an image RecordIO pack")
+    parser.add_argument("prefix", help="prefix of .lst/.rec files")
+    parser.add_argument("root", help="image root folder")
+    cgroup = parser.add_argument_group("Options for creating image lists")
+    cgroup.add_argument("--list", action="store_true")
+    cgroup.add_argument("--exts", nargs="+", default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--no-shuffle", dest="shuffle", action="store_false")
+    rgroup = parser.add_argument_group("Options for creating rec files")
+    rgroup.add_argument("--pass-through", action="store_true")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rgroup.add_argument("--encoding", type=str, default=".jpg",
+                        choices=[".jpg", ".png"])
+    rgroup.add_argument("--pack-label", action="store_true")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    args.prefix = os.path.abspath(args.prefix)
+    args.root = os.path.abspath(args.root)
+    if args.list:
+        image_list = list(list_image(args.root, args.recursive, args.exts))
+        if args.shuffle:
+            random.seed(100)
+            random.shuffle(image_list)
+        N = len(image_list)
+        chunk_size = (N + args.chunks - 1) // args.chunks
+        for i in range(args.chunks):
+            chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+            str_chunk = f"_{i}" if args.chunks > 1 else ""
+            sep = int(chunk_size * args.train_ratio)
+            sep_test = int(chunk_size * args.test_ratio)
+            if args.train_ratio == 1.0:
+                write_list(args.prefix + str_chunk + ".lst", chunk)
+            else:
+                if args.test_ratio:
+                    write_list(args.prefix + str_chunk + "_test.lst",
+                               chunk[:sep_test])
+                if args.train_ratio + args.test_ratio < 1.0:
+                    write_list(args.prefix + str_chunk + "_val.lst",
+                               chunk[sep_test + sep:])
+                write_list(args.prefix + str_chunk + "_train.lst",
+                           chunk[sep_test:sep_test + sep])
+        return
+
+    from mxnet_tpu import recordio
+
+    files = [
+        os.path.join(os.path.dirname(args.prefix), f)
+        for f in os.listdir(os.path.dirname(args.prefix) or ".")
+        if f.startswith(os.path.basename(args.prefix)) and f.endswith(".lst")
+    ]
+    for fname in files:
+        print("Creating .rec file from", fname)
+        base = os.path.splitext(fname)[0]
+        record = recordio.MXIndexedRecordIO(base + ".idx", base + ".rec", "w")
+        for i, item in enumerate(read_list(fname)):
+            payload = image_encode(args, i, item, None)
+            record.write_idx(item[0], payload)
+            if i % 1000 == 0:
+                print("pack:", i)
+        record.close()
+
+
+if __name__ == "__main__":
+    main()

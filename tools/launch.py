@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: ``tools/launch.py`` + dmlc-tracker).
+
+The reference spawned scheduler/server/worker processes over ssh/mpi with
+``DMLC_*`` env vars. TPU-native: there are no servers — every worker is a
+JAX process in one SPMD world, bootstrapped by the PJRT coordination
+service. This launcher covers the reference's ``--launcher local`` mode
+(N processes on this host, used by the nightly dist tests) and emits the
+env contract for multi-host launches.
+
+  python tools/launch.py -n 4 python train.py --kv-store dist_tpu_sync
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Launch a distributed job")
+    parser.add_argument("-n", "--num-workers", type=int, required=True)
+    parser.add_argument("-s", "--num-servers", type=int, default=0,
+                        help="ignored (no parameter servers on TPU); kept "
+                             "for reference CLI compatibility")
+    parser.add_argument("--launcher", type=str, default="local",
+                        choices=["local", "ssh", "mpi", "sge", "yarn"])
+    parser.add_argument("-H", "--hostfile", type=str,
+                        help="hostfile (multi-host; each host runs one process)")
+    parser.add_argument("--coordinator", type=str, default="127.0.0.1:49137")
+    parser.add_argument("--env", type=str, default="",
+                        help="extra VAR=VAL pairs, comma separated")
+    parser.add_argument("command", nargs="+")
+    args = parser.parse_args()
+
+    if args.launcher != "local":
+        sys.exit(
+            f"launcher '{args.launcher}' requires external orchestration on "
+            "TPU pods: run one copy of your script per host with env "
+            "MXTPU_COORDINATOR=<host:port> MXTPU_NUM_PROCESSES=<n> "
+            "MXTPU_PROCESS_ID=<rank> (these map onto "
+            "jax.distributed.initialize), e.g. via gcloud compute tpus "
+            "tpu-vm ssh --worker=all."
+        )
+
+    procs = []
+
+    def terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, terminate)
+    signal.signal(signal.SIGTERM, terminate)
+
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": args.coordinator,
+            "MXTPU_NUM_PROCESSES": str(args.num_workers),
+            "MXTPU_PROCESS_ID": str(rank),
+            # reference-compat names so old scripts keep working:
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(rank),
+            "DMLC_ROLE": "worker",
+        })
+        for pair in filter(None, args.env.split(",")):
+            k, _, v = pair.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    sys.exit(code)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Parse training logs (reference: ``tools/parse_log.py``): extracts
+epoch/accuracy/speed from Speedometer output."""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines):
+    res = []
+    cur = {}
+    for line in lines:
+        m = re.search(r"Epoch\[(\d+)\] Batch \[(\d+)\]\s*Speed: ([\d.]+)", line)
+        if m:
+            cur.setdefault("epoch", int(m.group(1)))
+            cur.setdefault("speeds", []).append(float(m.group(3)))
+        m = re.search(r"Epoch\[(\d+)\] Train-([\w-]+)=([\d.]+)", line)
+        if m:
+            cur[f"train_{m.group(2)}"] = float(m.group(3))
+        m = re.search(r"Epoch\[(\d+)\] Validation-([\w-]+)=([\d.]+)", line)
+        if m:
+            cur[f"val_{m.group(2)}"] = float(m.group(3))
+        m = re.search(r"Epoch\[(\d+)\] Time cost=([\d.]+)", line)
+        if m:
+            cur["time"] = float(m.group(2))
+            res.append(cur)
+            cur = {}
+    return res
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("logfile")
+    args = parser.parse_args()
+    with open(args.logfile) as f:
+        rows = parse(f)
+    if not rows:
+        print("no epochs found")
+        return
+    keys = sorted({k for r in rows for k in r if k != "speeds"})
+    print("\t".join(keys + ["mean_speed"]))
+    for r in rows:
+        speed = sum(r.get("speeds", [0])) / max(len(r.get("speeds", [1])), 1)
+        print("\t".join(str(r.get(k, "")) for k in keys) + f"\t{speed:.1f}")
+
+
+if __name__ == "__main__":
+    main()
