@@ -1,0 +1,21 @@
+"""``mxnet_tpu.models`` — NLP/LLM model families.
+
+The reference's NLP zoo lived in GluonNLP (external repo; SURVEY.md §6
+"BERT-base ... lives in GluonNLP repo scripts, not core"); this package
+provides the equivalent in-tree: transformer building blocks, BERT
+(config #3 of BASELINE.json), a seq2seq Transformer, and the Llama-3
+stretch family (config #5) with tensor/sequence-parallel sharding maps.
+"""
+
+from .bert import (  # noqa: F401
+    BERTModel,
+    BERTEncoder,
+    MultiHeadAttention,
+    PositionwiseFFN,
+    TransformerEncoderCell,
+    get_bert_model,
+    bert_base,
+    bert_large,
+)
+from .transformer import Transformer, TransformerDecoderCell  # noqa: F401
+from .llama import LlamaModel, get_llama, llama3_8b, llama_tiny  # noqa: F401

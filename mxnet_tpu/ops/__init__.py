@@ -5,4 +5,4 @@ Importing this package registers the full op surface
 """
 
 from . import registry, dispatch  # noqa: F401
-from . import math, shape_ops, nn, ctc, contrib  # noqa: F401  (registration side effects)
+from . import math, shape_ops, nn, ctc, contrib, flash_attention  # noqa: F401

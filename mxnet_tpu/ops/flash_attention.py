@@ -1,0 +1,240 @@
+"""Flash (blockwise) attention — Pallas TPU kernel + blockwise VJP.
+
+No reference counterpart: MXNet 1.x predates flash attention (SURVEY.md
+§5.7 — "a genuinely new capability, not a port"); the closest reference
+surface is ``contrib/transformer.cc`` interleaved attention, which this
+subsumes.
+
+Design:
+- Forward: Pallas kernel, grid (batch*heads, q_blocks, kv_blocks), online
+  softmax in fp32 VMEM scratch (m, l, acc); causal blocks short-circuit.
+  O(T) memory — no T×S score matrix ever materializes in HBM.
+- Backward: blockwise ``lax.scan`` recomputation from the saved LSE —
+  also O(T) memory. (Pallas bwd kernel is a later optimization.)
+- CPU/debug fallback: same math in plain jnp (the test oracle).
+
+Layout: (B, H, T, D) with D <= 128 on the kernel path (MXU lane width);
+larger D falls back to the jnp path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_NEG_INF = -1e30
+
+
+def _use_pallas(d):
+    if d > 128:
+        return False
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
+                      kv_blocks):
+    ki = pl.program_id(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0].astype(jnp.float32) * scale         # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                 # (bk, d)
+        v = v_ref[0].astype(jnp.float32)                 # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_scr[:]                                # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                           # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                  # (bq, 1)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    if causal:
+        # skip blocks entirely above the diagonal
+        @pl.when(ki * bk <= qi * bq + bq - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+
+
+try:  # pallas import kept optional so CPU-only environments still import
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _pallas_flash_fwd(q, k, v, scale, causal, bq=128, bk=128):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bq = min(bq, T)
+    bk = min(bk, S)
+    assert T % bq == 0 and S % bk == 0, "seq lens must divide block sizes"
+    qr = q.reshape(B * H, T, D)
+    kr = k.reshape(B * H, S, D)
+    vr = v.reshape(B * H, S, D)
+    kv_blocks = S // bk
+    grid = (B * H, T // bq, kv_blocks)
+    kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, kv_blocks=kv_blocks)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )(qr, kr, vr)
+    return out.reshape(B, H, T, D), lse.reshape(B, H, T)
+
+
+# ---------------------------------------------------------------------------
+# jnp blockwise reference (CPU path + oracle)
+# ---------------------------------------------------------------------------
+
+
+def _jnp_flash_fwd(q, k, v, scale, causal):
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    s = jnp.einsum("bhtd,bhsd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhts,bhsd->bhtd", p / l, v.astype(jnp.float32))
+    lse = (m + jnp.log(l))[..., 0]
+    return o.astype(q.dtype), lse
+
+
+# ---------------------------------------------------------------------------
+# custom VJP: blockwise backward via scan over kv blocks
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_core(q, k, v, scale, causal, block_size):
+    out, _ = _fwd_impl(q, k, v, scale, causal, block_size)
+    return out
+
+
+def _fwd_impl(q, k, v, scale, causal, block_size):
+    if _HAS_PALLAS and _use_pallas(q.shape[-1]) \
+            and (not causal or q.shape[2] == k.shape[2]) \
+            and q.shape[2] % min(block_size, q.shape[2]) == 0 \
+            and k.shape[2] % min(block_size, k.shape[2]) == 0:
+        return _pallas_flash_fwd(q, k, v, scale, causal,
+                                 bq=block_size, bk=block_size)
+    return _jnp_flash_fwd(q, k, v, scale, causal)
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_size):
+    out, lse = _fwd_impl(q, k, v, scale, causal, block_size)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_size, res, g):
+    q, k, v, out, lse = res
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    bk = min(block_size, S)
+    g32 = g.astype(jnp.float32)
+    q32 = q.astype(jnp.float32)
+    delta = jnp.sum(g32 * out.astype(jnp.float32), axis=-1)  # (B,H,T)
+
+    nblocks = S // bk if S % bk == 0 else 1
+    if S % bk != 0:
+        bk = S
+
+    def kv_block(j):
+        ks = lax.dynamic_slice_in_dim(k, j * bk, bk, axis=2).astype(jnp.float32)
+        vs = lax.dynamic_slice_in_dim(v, j * bk, bk, axis=2).astype(jnp.float32)
+        s = jnp.einsum("bhtd,bhsd->bhts", q32, ks) * scale
+        if causal:
+            rows = jnp.arange(T)[:, None]
+            cols = j * bk + jnp.arange(bk)[None, :]
+            s = jnp.where(rows >= cols + (T - S), s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (B,H,T,bk)
+        dv = jnp.einsum("bhts,bhtd->bhsd", p, g32)
+        dp = jnp.einsum("bhtd,bhsd->bhts", g32, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = jnp.einsum("bhts,bhsd->bhtd", ds, ks)
+        dk = jnp.einsum("bhts,bhtd->bhsd", ds, q32)
+        return dq, dk, dv
+
+    def scan_body(dq_acc, j):
+        dq_j, dk_j, dv_j = kv_block(j)
+        return dq_acc + dq_j, (dk_j, dv_j)
+
+    dq, (dks, dvs) = lax.scan(scan_body,
+                              jnp.zeros(q.shape, jnp.float32),
+                              jnp.arange(nblocks))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(k.shape)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(v.shape)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@register("flash_attention", aliases=("_contrib_flash_attention",))
+def flash_attention(query, key, value, scale=None, causal=False,
+                    block_size=128):
+    """Memory-efficient attention. query/key/value: (B, H, T, D)."""
+    if scale is None:
+        scale = 1.0 / (query.shape[-1] ** 0.5)
+    return flash_attention_core(query, key, value, float(scale), bool(causal),
+                                int(block_size))

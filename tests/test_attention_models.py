@@ -1,0 +1,189 @@
+"""Flash attention, ring attention, and NLP model tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, models, parallel
+
+
+def _dense_attn(q, k, v, causal=False):
+    D = q.shape[-1]
+    s = np.einsum("bhtd,bhsd->bhts", q, k) / np.sqrt(D)
+    if causal:
+        T, S = q.shape[2], k.shape[2]
+        mask = np.tril(np.ones((T, S), bool))
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bhts,bhsd->bhtd", p, v)
+
+
+def test_flash_attention_forward():
+    B, H, T, D = 2, 2, 16, 8
+    q = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    k = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    v = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    out = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k), mx.nd.array(v))
+    np.testing.assert_allclose(out.asnumpy(), _dense_attn(q, k, v),
+                               rtol=1e-4, atol=1e-5)
+    outc = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                 mx.nd.array(v), causal=True)
+    np.testing.assert_allclose(outc.asnumpy(), _dense_attn(q, k, v, True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_grad_matches_dense():
+    B, H, T, D = 1, 2, 8, 4
+    qn = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    kn = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    vn = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    q, k, v = mx.nd.array(qn), mx.nd.array(kn), mx.nd.array(vn)
+    for a in (q, k, v):
+        a.attach_grad()
+    with autograd.record():
+        o = mx.nd.flash_attention(q, k, v, causal=True)
+        loss = (o * o).sum()
+    loss.backward()
+
+    def dense(qq, kk, vv):
+        s = jnp.einsum("bhtd,bhsd->bhts", qq, kk) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhts,bhsd->bhtd", p, vv)
+        return (o * o).sum()
+
+    gq, gk, gv = jax.grad(dense, argnums=(0, 1, 2))(
+        jnp.asarray(qn), jnp.asarray(kn), jnp.asarray(vn))
+    np.testing.assert_allclose(q.grad.asnumpy(), np.asarray(gq), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(k.grad.asnumpy(), np.asarray(gk), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(v.grad.asnumpy(), np.asarray(gv), rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_ring_attention_matches_dense():
+    B, H, T, D = 2, 2, 16, 8
+    q = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    k = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    v = np.random.randn(B, H, T, D).astype(np.float32) * 0.5
+    mesh = parallel.make_mesh({"sp": 4}, devices=jax.devices()[:4])
+    out = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), mesh)
+    np.testing.assert_allclose(np.asarray(out), _dense_attn(q, k, v),
+                               rtol=1e-4, atol=1e-5)
+    outc = parallel.ring_attention(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(outc), _dense_attn(q, k, v, True),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multi_head_attention_block():
+    mha = models.MultiHeadAttention(units=32, num_heads=4)
+    mha.initialize(init=mx.initializer.Xavier())
+    x = mx.nd.random.normal(shape=(2, 10, 32))
+    out = mha(x)
+    assert out.shape == (2, 10, 32)
+
+
+def test_bert_forward_and_hybrid():
+    bert = models.get_bert_model("bert_12_768_12", vocab_size=50,
+                                 num_layers=2, units=32, hidden_size=64,
+                                 num_heads=4, dropout=0.0)
+    bert.initialize(init=mx.initializer.Normal(0.02))
+    ids = mx.nd.array(np.random.randint(0, 50, (2, 12)).astype(np.float32))
+    tt = mx.nd.zeros((2, 12))
+    seq, pooled, cls, dec = bert(ids, tt)
+    assert seq.shape == (2, 12, 32)
+    assert pooled.shape == (2, 32)
+    assert cls.shape == (2, 2)
+    assert dec.shape == (2, 12, 50)
+    bert.hybridize()
+    seq2 = bert(ids, tt)[0]
+    np.testing.assert_allclose(seq.asnumpy(), seq2.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bert_trains():
+    bert = models.get_bert_model("bert_12_768_12", vocab_size=50,
+                                 num_layers=1, units=32, hidden_size=64,
+                                 num_heads=4, dropout=0.0,
+                                 use_decoder=False)
+    bert.initialize(init=mx.initializer.Normal(0.02))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def nsp_loss(outputs, labels):
+        return loss_fn(outputs[2], labels)
+
+    step = parallel.SPMDTrainStep(bert, nsp_loss, "adam", {}, mesh=None)
+    ids = mx.nd.array(np.random.randint(0, 50, (4, 12)).astype(np.float32))
+    y = mx.nd.array(np.random.randint(0, 2, (4,)).astype(np.float32))
+    losses = [step(ids, y, lr=1e-3) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_llama_tiny_train():
+    net = models.llama_tiny()
+    net.initialize(init=mx.initializer.Normal(0.02))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return loss_fn(logits.reshape((-1, logits.shape[-1])),
+                       labels.reshape((-1,)))
+
+    step = parallel.SPMDTrainStep(net, lm_loss, "adam", {}, mesh=None)
+    x = mx.nd.array(np.random.randint(0, 256, (2, 16)).astype(np.float32))
+    losses = [step(x, x, lr=1e-3) for _ in range(5)]
+    assert losses[-1] < losses[0]
+
+
+def test_llama_tp_dp_mesh():
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    net = models.llama_tiny()
+    net.initialize(init=mx.initializer.Normal(0.02))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return loss_fn(logits.reshape((-1, logits.shape[-1])),
+                       labels.reshape((-1,)))
+
+    step = parallel.SPMDTrainStep(net, lm_loss, "adam", {}, mesh=mesh,
+                                  param_sharding=net.tp_sharding_map())
+    x = mx.nd.array(np.random.randint(0, 256, (8, 16)).astype(np.float32))
+    l0 = step(x, x, lr=1e-3)
+    l1 = step(x, x, lr=1e-3)
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_transformer_mt():
+    tr = models.Transformer(30, 40, num_layers=1, units=16, hidden_size=32,
+                            num_heads=2, dropout=0.0)
+    tr.initialize(init=mx.initializer.Normal(0.02))
+    src = mx.nd.array(np.random.randint(0, 30, (2, 8)).astype(np.float32))
+    tgt = mx.nd.array(np.random.randint(0, 40, (2, 6)).astype(np.float32))
+    out = tr(src, tgt)
+    assert out.shape == (2, 6, 40)
+
+
+def test_interleaved_matches_flash():
+    """contrib interleaved attention and flash attention agree."""
+    T, N, H, D = 8, 2, 2, 4
+    qkv = np.random.randn(T, N, 3 * H * D).astype(np.float32) * 0.5
+    att = mx.nd.contrib.interleaved_matmul_selfatt_qk(mx.nd.array(qkv), heads=H)
+    probs = mx.nd.softmax(att, axis=-1)
+    out1 = mx.nd.contrib.interleaved_matmul_selfatt_valatt(
+        mx.nd.array(qkv), probs, heads=H).asnumpy()
+    # same computation via flash path
+    qkv_r = qkv.reshape(T, N, H, 3, D)
+    q = np.transpose(qkv_r[:, :, :, 0], (1, 2, 0, 3))
+    k = np.transpose(qkv_r[:, :, :, 1], (1, 2, 0, 3))
+    v = np.transpose(qkv_r[:, :, :, 2], (1, 2, 0, 3))
+    out2 = mx.nd.flash_attention(mx.nd.array(q), mx.nd.array(k),
+                                 mx.nd.array(v)).asnumpy()
+    out2 = np.transpose(out2, (2, 0, 1, 3)).reshape(T, N, H * D)
+    np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=1e-5)
