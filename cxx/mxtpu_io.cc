@@ -1,0 +1,492 @@
+// Implementation of the native data plane. See mxtpu_io.h for the contract.
+//
+// RecordIO wire format (reference: dmlc-core include/dmlc/recordio.h):
+//   [uint32 magic=0xced7230a][uint32 lrec][payload][pad to 4B]
+//   lrec low 29 bits = length, high 3 bits = continuation flag (unused here:
+//   we neither emit nor expect multi-part records for packs < 512MB/record).
+#include "mxtpu_io.h"
+
+#include <cstdio>  // jpeglib.h needs FILE declared first
+
+#include <jpeglib.h>
+#include <png.h>
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void SetError(const std::string& msg) { g_last_error = msg; }
+
+constexpr uint32_t kMagic = 0xced7230a;
+constexpr uint32_t kLenMask = (1u << 29) - 1;
+
+struct RecordIOFile {
+  FILE* fp = nullptr;
+  bool writable = false;
+  std::vector<uint8_t> buf;
+};
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf jmp;
+};
+
+void JpegErrorExit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErrorMgr*>(cinfo->err);
+  longjmp(err->jmp, 1);
+}
+
+bool DecodeJpeg(const uint8_t* buf, uint64_t len, int desired_channels,
+                uint8_t* out, int* w, int* h, int* c) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrorExit;
+  if (setjmp(jerr.jmp)) {
+    jpeg_destroy_decompress(&cinfo);
+    SetError("jpeg decode failed");
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(buf), len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = desired_channels == 1 ? JCS_GRAYSCALE : JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  *w = cinfo.output_width;
+  *h = cinfo.output_height;
+  *c = cinfo.output_components;
+  if (out != nullptr) {
+    const int stride = (*w) * (*c);
+    std::vector<uint8_t*> rows(*h);
+    for (int y = 0; y < *h; ++y) rows[y] = out + y * stride;
+    while (cinfo.output_scanline < cinfo.output_height) {
+      JSAMPROW row = rows[cinfo.output_scanline];
+      jpeg_read_scanlines(&cinfo, &row, 1);
+    }
+    jpeg_finish_decompress(&cinfo);
+  }
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+struct PngReadCtx {
+  const uint8_t* data;
+  uint64_t size;
+  uint64_t offset;
+};
+
+void PngReadFn(png_structp png, png_bytep out, png_size_t count) {
+  auto* ctx = static_cast<PngReadCtx*>(png_get_io_ptr(png));
+  if (ctx->offset + count > ctx->size) {
+    png_error(png, "png: out of data");
+  }
+  std::memcpy(out, ctx->data + ctx->offset, count);
+  ctx->offset += count;
+}
+
+bool DecodePng(const uint8_t* buf, uint64_t len, int desired_channels,
+               uint8_t* out, int* w, int* h, int* c) {
+  png_structp png =
+      png_create_read_struct(PNG_LIBPNG_VER_STRING, nullptr, nullptr, nullptr);
+  if (!png) return false;
+  png_infop info = png_create_info_struct(png);
+  if (setjmp(png_jmpbuf(png))) {
+    png_destroy_read_struct(&png, &info, nullptr);
+    SetError("png decode failed");
+    return false;
+  }
+  PngReadCtx ctx{buf, len, 0};
+  png_set_read_fn(png, &ctx, PngReadFn);
+  png_read_info(png, info);
+  png_set_expand(png);
+  png_set_strip_16(png);
+  png_set_strip_alpha(png);
+  if (desired_channels == 1) {
+    png_set_rgb_to_gray(png, 1, -1, -1);
+  } else if (png_get_color_type(png, info) == PNG_COLOR_TYPE_GRAY ||
+             png_get_color_type(png, info) == PNG_COLOR_TYPE_GRAY_ALPHA) {
+    png_set_gray_to_rgb(png);
+  }
+  png_read_update_info(png, info);
+  *w = png_get_image_width(png, info);
+  *h = png_get_image_height(png, info);
+  *c = png_get_channels(png, info);
+  if (out != nullptr) {
+    const int stride = (*w) * (*c);
+    std::vector<png_bytep> rows(*h);
+    for (int y = 0; y < *h; ++y) rows[y] = out + y * stride;
+    png_read_image(png, rows.data());
+  }
+  png_destroy_read_struct(&png, &info, nullptr);
+  return true;
+}
+
+void ResizeBilinear(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                    int dh, int dw) {
+  const float ys = dh > 1 ? static_cast<float>(sh) / dh : 0.f;
+  const float xs = dw > 1 ? static_cast<float>(sw) / dw : 0.f;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * ys - 0.5f;
+    int y0 = std::max(0, static_cast<int>(fy));
+    int y1 = std::min(sh - 1, y0 + 1);
+    float ly = std::min(std::max(fy - y0, 0.f), 1.f);
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * xs - 0.5f;
+      int x0 = std::max(0, static_cast<int>(fx));
+      int x1 = std::min(sw - 1, x0 + 1);
+      float lx = std::min(std::max(fx - x0, 0.f), 1.f);
+      for (int ch = 0; ch < c; ++ch) {
+        float v = src[(y0 * sw + x0) * c + ch] * (1 - ly) * (1 - lx) +
+                  src[(y0 * sw + x1) * c + ch] * (1 - ly) * lx +
+                  src[(y1 * sw + x0) * c + ch] * ly * (1 - lx) +
+                  src[(y1 * sw + x1) * c + ch] * ly * lx;
+        dst[(y * dw + x) * c + ch] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}
+
+// ------------------- pipeline -------------------
+
+struct IRHeader {  // reference: python/mxnet/recordio.py IRHeader 'IfQQ'
+  uint32_t flag;
+  float label;
+  uint64_t id;
+  uint64_t id2;
+};
+
+struct Sample {
+  std::vector<float> data;    // c*h*w normalized CHW
+  std::vector<float> label;   // label_width
+  bool ok = false;
+};
+
+struct Pipeline {
+  std::string rec_path;
+  std::vector<std::pair<uint64_t, uint64_t>> index;  // (key, offset)
+  int batch, c, h, w, label_width;
+  bool shuffle, rand_crop, rand_mirror;
+  float mean[3] = {0, 0, 0}, stdv[3] = {1, 1, 1};
+  uint64_t seed;
+
+  std::vector<size_t> order;
+  std::atomic<size_t> next_idx{0};
+  size_t epoch_cursor = 0;
+
+  std::vector<std::thread> workers;
+  std::deque<Sample> queue;
+  std::mutex mu;
+  std::condition_variable cv_prod, cv_cons;
+  bool stopping = false;
+  size_t inflight = 0;
+  static constexpr size_t kQueueCap = 256;
+
+  std::mt19937_64 rng;
+
+  ~Pipeline() { Stop(); }
+
+  void Stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+    }
+    cv_prod.notify_all();
+    cv_cons.notify_all();
+    for (auto& t : workers) {
+      if (t.joinable()) t.join();
+    }
+    workers.clear();
+  }
+
+  bool LoadIndex(const std::string& idx_path) {
+    std::ifstream f(idx_path);
+    if (!f) {
+      SetError("cannot open index " + idx_path);
+      return false;
+    }
+    uint64_t key, off;
+    while (f >> key >> off) index.emplace_back(key, off);
+    return !index.empty();
+  }
+
+  bool ProcessOne(size_t pos, FILE* fp, std::mt19937_64& trng, Sample* out) {
+    uint64_t offset = index[order[pos]].second;
+    if (fseeko(fp, offset, SEEK_SET) != 0) return false;
+    uint32_t hdr[2];
+    if (fread(hdr, 4, 2, fp) != 2 || hdr[0] != kMagic) return false;
+    uint64_t len = hdr[1] & kLenMask;
+    std::vector<uint8_t> payload(len);
+    if (fread(payload.data(), 1, len, fp) != len) return false;
+
+    IRHeader ir;
+    std::memcpy(&ir, payload.data(), sizeof(IRHeader));
+    const uint8_t* img = payload.data() + sizeof(IRHeader);
+    uint64_t img_len = len - sizeof(IRHeader);
+    out->label.assign(label_width, 0.f);
+    if (ir.flag > 0) {
+      const float* labels = reinterpret_cast<const float*>(img);
+      for (int i = 0; i < label_width && i < static_cast<int>(ir.flag); ++i)
+        out->label[i] = labels[i];
+      img += ir.flag * 4;
+      img_len -= ir.flag * 4;
+    } else {
+      out->label[0] = ir.label;
+    }
+
+    int iw, ih, ic;
+    bool is_png = img_len > 8 && img[0] == 0x89 && img[1] == 'P';
+    if (is_png) {
+      if (!DecodePng(img, img_len, c, nullptr, &iw, &ih, &ic)) return false;
+    } else {
+      if (!DecodeJpeg(img, img_len, c, nullptr, &iw, &ih, &ic)) return false;
+    }
+    std::vector<uint8_t> raw(static_cast<size_t>(iw) * ih * ic);
+    if (is_png) {
+      if (!DecodePng(img, img_len, c, raw.data(), &iw, &ih, &ic)) return false;
+    } else {
+      if (!DecodeJpeg(img, img_len, c, raw.data(), &iw, &ih, &ic))
+        return false;
+    }
+
+    // crop/resize to target h x w
+    std::vector<uint8_t> hwc(static_cast<size_t>(w) * h * c);
+    if (ih == h && iw == w) {
+      hwc.assign(raw.begin(), raw.end());
+    } else if (ih >= h && iw >= w && rand_crop) {
+      std::uniform_int_distribution<int> dy(0, ih - h), dx(0, iw - w);
+      int y0 = dy(trng), x0 = dx(trng);
+      for (int y = 0; y < h; ++y)
+        std::memcpy(&hwc[static_cast<size_t>(y) * w * c],
+                    &raw[(static_cast<size_t>(y0 + y) * iw + x0) * c],
+                    static_cast<size_t>(w) * c);
+    } else if (ih >= h && iw >= w) {  // center crop
+      int y0 = (ih - h) / 2, x0 = (iw - w) / 2;
+      for (int y = 0; y < h; ++y)
+        std::memcpy(&hwc[static_cast<size_t>(y) * w * c],
+                    &raw[(static_cast<size_t>(y0 + y) * iw + x0) * c],
+                    static_cast<size_t>(w) * c);
+    } else {
+      ResizeBilinear(raw.data(), ih, iw, c, hwc.data(), h, w);
+    }
+
+    bool mirror = rand_mirror && (trng() & 1);
+    out->data.resize(static_cast<size_t>(c) * h * w);
+    for (int ch = 0; ch < c; ++ch) {
+      float m = mean[std::min(ch, 2)], s = stdv[std::min(ch, 2)];
+      float inv = s != 0.f ? 1.f / s : 1.f;
+      for (int y = 0; y < h; ++y) {
+        for (int x = 0; x < w; ++x) {
+          int sx = mirror ? (w - 1 - x) : x;
+          out->data[(static_cast<size_t>(ch) * h + y) * w + x] =
+              (static_cast<float>(hwc[(static_cast<size_t>(y) * w + sx) * c +
+                                      ch]) -
+               m) *
+              inv;
+        }
+      }
+    }
+    out->ok = true;
+    return true;
+  }
+
+  void WorkerLoop(int wid) {
+    FILE* fp = fopen(rec_path.c_str(), "rb");
+    std::mt19937_64 trng(seed + 0x9e3779b97f4a7c15ULL * (wid + 1));
+    while (true) {
+      size_t pos = next_idx.fetch_add(1);
+      if (pos >= order.size()) break;
+      Sample s;
+      ProcessOne(pos, fp, trng, &s);
+      std::unique_lock<std::mutex> lk(mu);
+      cv_prod.wait(lk, [&] { return queue.size() < kQueueCap || stopping; });
+      if (stopping) break;
+      queue.push_back(std::move(s));
+      cv_cons.notify_one();
+    }
+    if (fp) fclose(fp);
+    std::lock_guard<std::mutex> lk(mu);
+    if (--inflight == 0) cv_cons.notify_all();
+  }
+
+  void StartEpoch(int num_threads) {
+    Stop();
+    stopping = false;
+    queue.clear();
+    next_idx = 0;
+    order.resize(index.size());
+    for (size_t i = 0; i < order.size(); ++i) order[i] = i;
+    if (shuffle) {
+      std::shuffle(order.begin(), order.end(), rng);
+    }
+    inflight = num_threads_;
+    for (int i = 0; i < num_threads_; ++i)
+      workers.emplace_back(&Pipeline::WorkerLoop, this, i);
+  }
+
+  int num_threads_ = 1;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+
+int MXTPURecordIOOpen(const char* path, int mode, RecordIOHandle* out) {
+  auto* f = new RecordIOFile();
+  f->writable = mode == 1;
+  f->fp = fopen(path, mode == 1 ? "wb" : "rb");
+  if (!f->fp) {
+    SetError(std::string("cannot open ") + path);
+    delete f;
+    return -1;
+  }
+  *out = f;
+  return 0;
+}
+
+int MXTPURecordIOClose(RecordIOHandle h) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  if (f->fp) fclose(f->fp);
+  delete f;
+  return 0;
+}
+
+int64_t MXTPURecordIOReadRecord(RecordIOHandle h, const uint8_t** data) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  uint32_t hdr[2];
+  size_t n = fread(hdr, 4, 2, f->fp);
+  if (n == 0) return 0;  // EOF
+  if (n != 2 || hdr[0] != kMagic) {
+    SetError("invalid RecordIO magic");
+    return -1;
+  }
+  uint64_t len = hdr[1] & kLenMask;
+  f->buf.resize(len);
+  if (fread(f->buf.data(), 1, len, f->fp) != len) {
+    SetError("truncated record");
+    return -1;
+  }
+  uint64_t pad = (4 - (len % 4)) % 4;
+  if (pad) fseeko(f->fp, pad, SEEK_CUR);
+  *data = f->buf.data();
+  return static_cast<int64_t>(len);
+}
+
+int MXTPURecordIOWriteRecord(RecordIOHandle h, const uint8_t* data,
+                             uint64_t len) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  uint32_t hdr[2] = {kMagic, static_cast<uint32_t>(len & kLenMask)};
+  if (fwrite(hdr, 4, 2, f->fp) != 2) return -1;
+  if (fwrite(data, 1, len, f->fp) != len) return -1;
+  uint64_t pad = (4 - (len % 4)) % 4;
+  static const uint8_t zeros[4] = {0, 0, 0, 0};
+  if (pad && fwrite(zeros, 1, pad, f->fp) != pad) return -1;
+  return 0;
+}
+
+int MXTPURecordIOSeek(RecordIOHandle h, uint64_t pos) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  return fseeko(f->fp, pos, SEEK_SET);
+}
+
+int64_t MXTPURecordIOTell(RecordIOHandle h) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  return ftello(f->fp);
+}
+
+int MXTPUImageDecode(const uint8_t* buf, uint64_t len, int desired_channels,
+                     uint8_t* out, int* w, int* h, int* c) {
+  bool is_png = len > 8 && buf[0] == 0x89 && buf[1] == 'P';
+  bool ok = is_png ? DecodePng(buf, len, desired_channels, out, w, h, c)
+                   : DecodeJpeg(buf, len, desired_channels, out, w, h, c);
+  return ok ? 0 : -1;
+}
+
+int MXTPUImageResize(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                     int dh, int dw) {
+  ResizeBilinear(src, sh, sw, c, dst, dh, dw);
+  return 0;
+}
+
+int MXTPUPipelineCreate(const char* rec_path, const char* idx_path,
+                        int batch_size, int channels, int height, int width,
+                        int shuffle, int num_threads, int rand_crop,
+                        int rand_mirror, const float* mean, const float* std,
+                        int label_width, uint64_t seed, PipelineHandle* out) {
+  auto* p = new Pipeline();
+  p->rec_path = rec_path;
+  p->batch = batch_size;
+  p->c = channels;
+  p->h = height;
+  p->w = width;
+  p->shuffle = shuffle != 0;
+  p->rand_crop = rand_crop != 0;
+  p->rand_mirror = rand_mirror != 0;
+  p->label_width = label_width;
+  p->seed = seed;
+  p->rng.seed(seed);
+  p->num_threads_ = std::max(1, num_threads);
+  if (mean) std::copy(mean, mean + 3, p->mean);
+  if (std) std::copy(std, std + 3, p->stdv);
+  if (!p->LoadIndex(idx_path)) {
+    delete p;
+    return -1;
+  }
+  p->StartEpoch(p->num_threads_);
+  *out = p;
+  return 0;
+}
+
+int MXTPUPipelineNext(PipelineHandle h, float* data, float* label) {
+  auto* p = static_cast<Pipeline*>(h);
+  const size_t sample_size = static_cast<size_t>(p->c) * p->h * p->w;
+  int filled = 0;
+  while (filled < p->batch) {
+    Sample s;
+    {
+      std::unique_lock<std::mutex> lk(p->mu);
+      p->cv_cons.wait(lk, [&] {
+        return !p->queue.empty() || p->inflight == 0 || p->stopping;
+      });
+      if (p->queue.empty()) break;  // epoch done
+      s = std::move(p->queue.front());
+      p->queue.pop_front();
+    }
+    p->cv_prod.notify_one();
+    if (!s.ok) continue;  // skip corrupt records
+    std::memcpy(data + static_cast<size_t>(filled) * sample_size,
+                s.data.data(), sample_size * sizeof(float));
+    std::memcpy(label + static_cast<size_t>(filled) * p->label_width,
+                s.label.data(), p->label_width * sizeof(float));
+    ++filled;
+  }
+  return filled;
+}
+
+int MXTPUPipelineReset(PipelineHandle h) {
+  auto* p = static_cast<Pipeline*>(h);
+  p->StartEpoch(p->num_threads_);
+  return 0;
+}
+
+int MXTPUPipelineDestroy(PipelineHandle h) {
+  delete static_cast<Pipeline*>(h);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,67 @@
+// Native data-plane library for mxnet_tpu.
+//
+// TPU-native equivalent of the reference's C++ IO stack:
+//   - RecordIO reader/writer  (reference: dmlc-core recordio + src/io/)
+//   - JPEG/PNG decode          (reference: OpenCV imdecode in src/io/)
+//   - image augmentation       (reference: src/io/image_aug_default.cc)
+//   - threaded batch pipeline  (reference: iter_image_recordio_2.cc
+//                               ImageRecordIOParser2 + PrefetcherIter)
+//
+// Exposed as a flat C ABI (the L4 analog of include/mxnet/c_api.h) consumed
+// from Python via ctypes; batches land in caller-provided pinned host
+// buffers that feed jax.device_put zero-copy.
+#ifndef MXTPU_IO_H_
+#define MXTPU_IO_H_
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------- error handling ----------------
+const char* MXTPUGetLastError();
+
+// ---------------- RecordIO ----------------
+typedef void* RecordIOHandle;
+
+// mode: 0 = read, 1 = write
+int MXTPURecordIOOpen(const char* path, int mode, RecordIOHandle* out);
+int MXTPURecordIOClose(RecordIOHandle h);
+// returns length of next record, 0 at EOF, -1 on error; data pointer valid
+// until next call
+int64_t MXTPURecordIOReadRecord(RecordIOHandle h, const uint8_t** data);
+int MXTPURecordIOWriteRecord(RecordIOHandle h, const uint8_t* data,
+                             uint64_t len);
+int MXTPURecordIOSeek(RecordIOHandle h, uint64_t pos);
+int64_t MXTPURecordIOTell(RecordIOHandle h);
+
+// ---------------- image decode ----------------
+// Decodes JPEG or PNG from memory. Returns 0 on success.
+// On success *w/*h/*c are filled; caller buffer `out` must hold w*h*c bytes
+// (pass out=nullptr to query dimensions only).
+int MXTPUImageDecode(const uint8_t* buf, uint64_t len, int desired_channels,
+                     uint8_t* out, int* w, int* h, int* c);
+
+// bilinear resize HWC uint8
+int MXTPUImageResize(const uint8_t* src, int sh, int sw, int c, uint8_t* dst,
+                     int dh, int dw);
+
+// ---------------- threaded RecordIO image pipeline ----------------
+typedef void* PipelineHandle;
+
+// Creates a pipeline over an indexed RecordIO pack producing float32 NCHW
+// batches (mean/std normalized) + float32 labels.
+int MXTPUPipelineCreate(const char* rec_path, const char* idx_path,
+                        int batch_size, int channels, int height, int width,
+                        int shuffle, int num_threads, int rand_crop,
+                        int rand_mirror, const float* mean, const float* std,
+                        int label_width, uint64_t seed, PipelineHandle* out);
+// Fills data (batch*c*h*w floats) and label (batch*label_width floats).
+// Returns number of valid samples in batch, 0 at epoch end, -1 on error.
+int MXTPUPipelineNext(PipelineHandle h, float* data, float* label);
+int MXTPUPipelineReset(PipelineHandle h);
+int MXTPUPipelineDestroy(PipelineHandle h);
+
+}  // extern "C"
+
+#endif  // MXTPU_IO_H_

@@ -1,0 +1,144 @@
+"""ctypes binding for the native data-plane library (``cxx/libmxtpu.so``).
+
+Reference analog: ``python/mxnet/base.py`` loading ``libmxnet.so`` — here
+the native surface is only the data plane (RecordIO, codecs, threaded
+pipeline); compute is XLA's job. Builds the library on first use if the
+toolchain is available; all callers degrade to pure-Python paths when not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_LIB = None
+_LOCK = threading.Lock()
+_CXX_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "cxx")
+_SO_PATH = os.path.join(_CXX_DIR, "libmxtpu.so")
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", _CXX_DIR, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Returns the loaded library or None if unavailable."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB if _LIB is not False else None
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB if _LIB is not False else None
+        if not os.path.exists(_SO_PATH) and not _build():
+            _LIB = False
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError:
+            _LIB = False
+            return None
+        lib.MXTPUGetLastError.restype = ctypes.c_char_p
+        lib.MXTPURecordIOReadRecord.restype = ctypes.c_int64
+        lib.MXTPURecordIOReadRecord.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+        lib.MXTPURecordIOTell.restype = ctypes.c_int64
+        lib.MXTPUPipelineCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_void_p)]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class NativeImagePipeline:
+    """Threaded C++ RecordIO->decode->augment->batch pipeline.
+
+    Reference analog: ``src/io/iter_image_recordio_2.cc``. Produces float32
+    NCHW batches in numpy buffers ready for device upload.
+    """
+
+    def __init__(self, rec_path, idx_path, batch_size, data_shape,
+                 shuffle=False, num_threads=4, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None, label_width=1,
+                 seed=0):
+        import numpy as np
+
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        c, h, w = data_shape
+        self._shape = (batch_size, c, h, w)
+        self._label_width = label_width
+        mean_arr = (ctypes.c_float * 3)(*(list(mean) if mean is not None
+                                          else [0.0, 0.0, 0.0]))
+        std_arr = (ctypes.c_float * 3)(*(list(std) if std is not None
+                                         else [1.0, 1.0, 1.0]))
+        handle = ctypes.c_void_p()
+        ret = lib.MXTPUPipelineCreate(
+            rec_path.encode(), idx_path.encode(), batch_size, c, h, w,
+            int(shuffle), num_threads, int(rand_crop), int(rand_mirror),
+            mean_arr, std_arr, label_width, seed, ctypes.byref(handle))
+        if ret != 0:
+            raise RuntimeError(
+                f"pipeline create failed: {lib.MXTPUGetLastError().decode()}")
+        self._handle = handle
+        self._data_buf = np.empty(self._shape, np.float32)
+        self._label_buf = np.empty((batch_size, label_width), np.float32)
+
+    def next_batch(self):
+        """Returns (data, label, n_valid) or None at epoch end."""
+        n = self._lib.MXTPUPipelineNext(
+            self._handle,
+            self._data_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._label_buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if n <= 0:
+            return None
+        return self._data_buf, self._label_buf, n
+
+    def reset(self):
+        self._lib.MXTPUPipelineReset(self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and self._lib is not None:
+            try:
+                self._lib.MXTPUPipelineDestroy(self._handle)
+            except Exception:
+                pass
+
+
+def decode_image(buf: bytes, channels=3):
+    """Native JPEG/PNG decode -> HWC uint8 numpy array (or None)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    w = ctypes.c_int()
+    h = ctypes.c_int()
+    c = ctypes.c_int()
+    raw = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    if lib.MXTPUImageDecode(raw, len(buf), channels, None,
+                            ctypes.byref(w), ctypes.byref(h),
+                            ctypes.byref(c)) != 0:
+        return None
+    out = np.empty((h.value, w.value, c.value), np.uint8)
+    if lib.MXTPUImageDecode(raw, len(buf), channels,
+                            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                            ctypes.byref(w), ctypes.byref(h),
+                            ctypes.byref(c)) != 0:
+        return None
+    return out

@@ -362,24 +362,69 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
+class _NativeImageRecordIter(DataIter):
+    """C++-backed RecordIO image pipeline (the reference's
+    ``ImageRecordIter2`` role — decode/augment/batch off the Python thread)."""
+
+    def __init__(self, pipeline, batch_size, data_shape, label_width):
+        super().__init__(batch_size)
+        self._pipe = pipeline
+        self.provide_data = [DataDesc("data", (batch_size,) + tuple(data_shape))]
+        self.provide_label = [DataDesc("softmax_label",
+                                       (batch_size, label_width))]
+
+    def reset(self):
+        self._pipe.reset()
+
+    def next(self):
+        res = self._pipe.next_batch()
+        if res is None:
+            raise StopIteration
+        data, label, n = res
+        return DataBatch(data=[_array(data.copy())],
+                         label=[_array(label.copy())],
+                         pad=self.batch_size - n)
+
+
 def ImageRecordIter(path_imgrec=None, data_shape=(3, 224, 224), batch_size=1,
                     label_width=1, shuffle=False, rand_crop=False,
                     rand_mirror=False, mean_r=0, mean_g=0, mean_b=0,
                     std_r=1, std_g=1, std_b=1, resize=0, preprocess_threads=4,
-                    prefetch_buffer=4, **kwargs):
+                    prefetch_buffer=4, seed=0, **kwargs):
     """Threaded RecordIO image pipeline (reference:
     ``src/io/iter_image_recordio_2.cc`` via factory registration).
 
-    Python front over ``image.ImageIter`` + ``PrefetchingIter``; the decode
-    hot loop drops into the C++ helper in ``cxx/`` when available.
+    Uses the C++ pipeline in ``cxx/libmxtpu.so`` (decode + augment + batch
+    on native threads) when available; falls back to the Python
+    ``image.ImageIter`` + ``PrefetchingIter`` otherwise.
     """
+    import os
+
     import numpy as np
 
-    from ..image import ImageIter
+    from .. import _native
 
     mean = None
     if mean_r or mean_g or mean_b:
         mean = np.array([mean_r, mean_g, mean_b])
+
+    if path_imgrec and _native.available() and not kwargs.get("aug_list"):
+        idx_path = kwargs.get("path_imgidx") or \
+            os.path.splitext(path_imgrec)[0] + ".idx"
+        if os.path.exists(idx_path):
+            std = [std_r, std_g, std_b] if (std_r != 1 or std_g != 1
+                                            or std_b != 1) else None
+            pipe = _native.NativeImagePipeline(
+                path_imgrec, idx_path, batch_size, tuple(data_shape),
+                shuffle=shuffle, num_threads=preprocess_threads,
+                rand_crop=rand_crop, rand_mirror=rand_mirror,
+                mean=list(mean) if mean is not None else None, std=std,
+                label_width=label_width, seed=seed)
+            return _NativeImageRecordIter(pipe, batch_size, data_shape,
+                                          label_width)
+
+    from ..image import ImageIter
+
     it = ImageIter(batch_size=batch_size, data_shape=tuple(data_shape),
                    label_width=label_width, path_imgrec=path_imgrec,
                    shuffle=shuffle, rand_crop=rand_crop,

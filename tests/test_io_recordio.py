@@ -1,0 +1,180 @@
+"""RecordIO / IO / image pipeline tests (incl. the C++ native path)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "test.rec")
+    w = recordio.MXRecordIO(path, "w")
+    payloads = [b"hello", b"x" * 1000, b"", b"abcd" * 7]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    for p in payloads:
+        assert r.read() == p
+    assert r.read() is None  # EOF
+    r.close()
+
+
+def test_indexed_recordio(tmp_path):
+    path = str(tmp_path / "test.rec")
+    idx = str(tmp_path / "test.idx")
+    w = recordio.MXIndexedRecordIO(idx, path, "w")
+    for i in range(10):
+        w.write_idx(i, f"record{i}".encode())
+    w.close()
+    r = recordio.MXIndexedRecordIO(idx, path, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record7"
+    assert r.read_idx(2) == b"record2"
+    r.close()
+
+
+def test_irheader_pack_unpack():
+    hdr = recordio.IRHeader(0, 3.5, 42, 0)
+    s = recordio.pack(hdr, b"payload")
+    hdr2, payload = recordio.unpack(s)
+    assert hdr2.label == pytest.approx(3.5)
+    assert hdr2.id == 42
+    assert payload == b"payload"
+    # multi-label
+    hdr = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0], np.float32), 7, 0)
+    s = recordio.pack(hdr, b"xyz")
+    hdr2, payload = recordio.unpack(s)
+    assert hdr2.flag == 3
+    np.testing.assert_allclose(hdr2.label, [1, 2, 3])
+    assert payload == b"xyz"
+
+
+def test_native_recordio_compat(tmp_path):
+    """The C++ reader parses packs written by the Python writer."""
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native lib unavailable")
+    import ctypes
+
+    path = str(tmp_path / "n.rec")
+    w = recordio.MXRecordIO(path, "w")
+    w.write(b"native-check-1")
+    w.write(b"second record longer payload")
+    w.close()
+    lib = _native.get_lib()
+    h = ctypes.c_void_p()
+    assert lib.MXTPURecordIOOpen(path.encode(), 0, ctypes.byref(h)) == 0
+    ptr = ctypes.POINTER(ctypes.c_uint8)()
+    n = lib.MXTPURecordIOReadRecord(h, ctypes.byref(ptr))
+    assert bytes(bytearray(ptr[:n])) == b"native-check-1"
+    n = lib.MXTPURecordIOReadRecord(h, ctypes.byref(ptr))
+    assert bytes(bytearray(ptr[:n])) == b"second record longer payload"
+    assert lib.MXTPURecordIOReadRecord(h, ctypes.byref(ptr)) == 0
+    lib.MXTPURecordIOClose(h)
+
+
+def _make_image_pack(tmp_path, n=12, hw=(40, 48)):
+    from mxnet_tpu.image import imencode
+
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(hw[0], hw[1], 3) * 255).astype(np.uint8)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 3), i, 0), imencode(img)))
+    w.close()
+    return rec, idx
+
+
+def test_image_record_iter_native(tmp_path):
+    from mxnet_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native lib unavailable")
+    rec, idx = _make_image_pack(tmp_path)
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, 32, 32),
+                               batch_size=4, shuffle=False,
+                               preprocess_threads=2)
+    total = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        total += 4 - (batch.pad or 0)
+    assert total == 12
+    it.reset()
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 32, 32)
+
+
+def test_ndarray_iter():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    label = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, label, batch_size=3, shuffle=False,
+                           last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (3, 4)
+    assert batches[-1].pad == 2
+    # discard mode
+    it2 = mx.io.NDArrayIter(data, label, batch_size=3,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 3
+
+
+def test_resize_iter():
+    data = np.random.rand(10, 4).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(10), batch_size=5)
+    r = mx.io.ResizeIter(base, 7)
+    assert len(list(r)) == 7
+
+
+def test_csv_iter(tmp_path):
+    f = str(tmp_path / "d.csv")
+    np.savetxt(f, np.random.rand(9, 4), delimiter=",")
+    it = mx.io.CSVIter(data_csv=f, data_shape=(4,), batch_size=3)
+    batches = list(it)
+    assert batches[0].data[0].shape == (3, 4)
+
+
+def test_prefetching_iter():
+    data = np.random.rand(12, 4).astype(np.float32)
+    base = mx.io.NDArrayIter(data, np.zeros(12), batch_size=4)
+    pf = mx.io.PrefetchingIter(base)
+    assert len(list(pf)) == 3
+    pf.reset()
+    assert len(list(pf)) == 3
+
+
+def test_native_image_decode_matches_pil():
+    from mxnet_tpu import _native
+    from mxnet_tpu.image import imdecode, imencode
+
+    if not _native.available():
+        pytest.skip("native lib unavailable")
+    img = (np.random.RandomState(1).rand(24, 30, 3) * 255).astype(np.uint8)
+    buf = imencode(img)
+    nat = _native.decode_image(buf)
+    pil = imdecode(buf).asnumpy()
+    assert np.abs(nat.astype(int) - pil.astype(int)).max() == 0
+
+
+def test_image_ops(tmp_path):
+    from mxnet_tpu import image
+
+    img = mx.nd.array((np.random.rand(30, 40, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    resized = image.imresize(img, 20, 10)
+    assert resized.shape == (10, 20, 3)
+    cropped, _ = image.center_crop(img, (16, 16))
+    assert cropped.shape == (16, 16, 3)
+    rc, _ = image.random_crop(img, (8, 8))
+    assert rc.shape == (8, 8, 3)
+    short = image.resize_short(img, 20)
+    assert min(short.shape[:2]) == 20
