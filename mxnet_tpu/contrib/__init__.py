@@ -1,0 +1,5 @@
+"""``mx.contrib`` (reference: ``python/mxnet/contrib/``)."""
+
+from . import amp  # noqa: F401
+from . import onnx  # noqa: F401
+from . import quantization  # noqa: F401

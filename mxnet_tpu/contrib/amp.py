@@ -1,0 +1,13 @@
+"""``mx.contrib.amp`` — alias of the top-level AMP module (the reference
+shipped AMP under contrib; we promote it but keep the old import path)."""
+
+from ..amp import (  # noqa: F401
+    init,
+    init_trainer,
+    is_enabled,
+    convert_model,
+    convert_hybrid_block,
+    scale_loss,
+    unscale,
+    LossScaler,
+)

@@ -1,0 +1,38 @@
+"""ONNX import/export (reference: ``python/mxnet/contrib/onnx/``).
+
+The ``onnx`` package is not present in this environment; the API surface
+is kept (reference parity) and gated. For zoo interchange, the supported
+paths are: ``HybridBlock.export`` (symbol JSON + params, loadable by
+``SymbolBlock.imports``) and ``save_parameters``/``load_parameters``.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise MXNetError(
+            "the onnx package is not installed in this environment; use "
+            "HybridBlock.export / SymbolBlock.imports for model interchange"
+        ) from e
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    _require_onnx()
+
+
+def import_model(model_file):
+    _require_onnx()
+
+
+def import_to_gluon(model_file, ctx=None):
+    _require_onnx()
+
+
+def get_model_metadata(model_file):
+    _require_onnx()

@@ -1,0 +1,32 @@
+"""int8 quantization (reference: ``python/mxnet/contrib/quantization.py``
+over ``src/operator/quantization/``).
+
+Status: document-only for v1 (SURVEY.md §2.2 'quantization/': "document-only
+for v1; XLA int8 later"). The TPU-native path will be XLA int8 dots +
+Pallas quantized kernels; the calibration API is stubbed with clear errors
+so reference scripts fail loudly instead of silently.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+_MSG = ("int8 quantization is not yet implemented in the TPU build; "
+        "bf16 (mx.amp) is the supported reduced-precision path. "
+        "XLA int8 matmul support is planned.")
+
+
+def quantize_model(*args, **kwargs):
+    raise MXNetError(_MSG)
+
+
+def quantize_net(*args, **kwargs):
+    raise MXNetError(_MSG)
+
+
+def quantize_graph(*args, **kwargs):
+    raise MXNetError(_MSG)
+
+
+def calib_graph(*args, **kwargs):
+    raise MXNetError(_MSG)

@@ -16,6 +16,7 @@ class _RNNLayer(HybridBlock):
     def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
                  input_size, i2h_weight_initializer, h2h_weight_initializer,
                  i2h_bias_initializer, h2h_bias_initializer, mode, **kwargs):
+        self._mode = mode  # before super(): _alias() runs during Block init
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC"), f"Invalid layout {layout}"
         self._hidden_size = hidden_size

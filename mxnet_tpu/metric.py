@@ -91,9 +91,13 @@ def create(metric, *args, **kwargs):
         for m in metric:
             composite.add(create(m, *args, **kwargs))
         return composite
-    if metric.lower() not in _REGISTRY:
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "top_k_accuracy": "topkaccuracy", "top_k_acc": "topkaccuracy",
+               "pearsonr": "pearsoncorrelation", "nll_loss": "crossentropy"}
+    key = aliases.get(metric.lower(), metric.lower())
+    if key not in _REGISTRY:
         raise MXNetError(f"unknown metric {metric}")
-    return _REGISTRY[metric.lower()](*args, **kwargs)
+    return _REGISTRY[key](*args, **kwargs)
 
 
 @register

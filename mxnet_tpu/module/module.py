@@ -261,8 +261,15 @@ class Module(BaseModule):
             return
         if isinstance(optimizer, str):
             idx2name = dict(enumerate(self._param_names))
+            opt_params = dict(optimizer_params)
+            if "rescale_grad" not in opt_params:
+                # reference Module.init_optimizer defaults rescale_grad to
+                # 1/batch_size (grads are batch sums through SoftmaxOutput)
+                batch = next(iter(self._data_shapes.values()))[0] \
+                    if getattr(self, "_data_shapes", None) else 1
+                opt_params["rescale_grad"] = 1.0 / max(batch, 1)
             optimizer = _opt.create(optimizer, param_idx2name=idx2name,
-                                    **dict(optimizer_params))
+                                    **opt_params)
         self._optimizer = optimizer
         self._updater_states = {}
         self.optimizer_initialized = True

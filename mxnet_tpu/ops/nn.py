@@ -225,18 +225,21 @@ def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
                                normalization)
 
 
-@jax.custom_vjp
+import functools as _functools
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
 def _softmax_output_vjp(data, label, grad_scale, ignore_label, use_ignore, norm):
     return jax.nn.softmax(data, axis=-1)
 
 
 def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, norm):
     p = jax.nn.softmax(data, axis=-1)
-    return p, (p, label, grad_scale, ignore_label, use_ignore, norm)
+    return p, (p, label)
 
 
-def _so_bwd(res, g):
-    p, label, grad_scale, ignore_label, use_ignore, norm = res
+def _so_bwd(grad_scale, ignore_label, use_ignore, norm, res, g):
+    p, label = res
     oh = jax.nn.one_hot(label.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
     grad = p - oh
     if use_ignore:
@@ -247,7 +250,7 @@ def _so_bwd(res, g):
     elif norm == "valid" and use_ignore:
         keep = (label != ignore_label).astype(p.dtype)
         grad = grad / jnp.maximum(jnp.sum(keep), 1.0)
-    return (grad * grad_scale, None, None, None, None, None)
+    return (grad * grad_scale, jnp.zeros_like(label))
 
 
 _softmax_output_vjp.defvjp(_so_fwd, _so_bwd)

@@ -393,38 +393,154 @@ def _num_outputs_of(op, attrs):
     return 1
 
 
+def _prod(xs):
+    r = 1
+    for x in xs:
+        r *= x
+    return r
+
+
+def _infer_params_for_node(node, in_shapes):
+    """Deduce unknown VARIABLE input shapes from known data shapes —
+    the nnvm FInferShape role for layer ops (reference:
+    ``infer_graph_attr_pass.cc``). Returns {input_pos: shape}."""
+    op = node._op
+    a = node._attrs
+    out = {}
+    if op == "FullyConnected":
+        data = in_shapes[0]
+        if data is None:
+            return out
+        nh = int(a.get("num_hidden"))
+        flatten = a.get("flatten", True)
+        in_units = _prod(data[1:]) if flatten else data[-1]
+        out[1] = (nh, in_units)
+        if len(node._inputs) > 2 and not a.get("no_bias", False):
+            out[2] = (nh,)
+    elif op in ("Convolution", "Deconvolution"):
+        data = in_shapes[0]
+        if data is None:
+            return out
+        kernel = tuple(a.get("kernel", ()))
+        nf = int(a.get("num_filter"))
+        ng = int(a.get("num_group", 1))
+        cin = data[1]
+        if op == "Convolution":
+            out[1] = (nf, cin // ng) + kernel
+        else:
+            out[1] = (cin, nf // ng) + kernel
+        if len(node._inputs) > 2 and not a.get("no_bias", False):
+            out[2] = (nf,)
+    elif op in ("BatchNorm", "InstanceNorm", "GroupNorm"):
+        data = in_shapes[0]
+        if data is None:
+            return out
+        c = data[int(a.get("axis", 1))] if op == "BatchNorm" else data[1]
+        for i in range(1, len(node._inputs)):
+            out[i] = (c,)
+    elif op == "LayerNorm":
+        data = in_shapes[0]
+        if data is None:
+            return out
+        c = data[int(a.get("axis", -1))]
+        for i in range(1, len(node._inputs)):
+            out[i] = (c,)
+    elif op == "Embedding":
+        out[1] = (int(a.get("input_dim")), int(a.get("output_dim")))
+    elif op == "SoftmaxOutput":
+        data = in_shapes[0]
+        if data is None:
+            return out
+        out[1] = tuple(data[:-1])  # label
+    return out
+
+
 def _infer_graph_shapes(root, known_shapes):
-    """Run abstract evaluation over the graph with jax.eval_shape."""
+    """Fixed-point shape inference: forward abstract eval where inputs are
+    known; layer-specific parameter deduction where they aren't."""
     import jax
     import jax.numpy as jnp
 
-    from .executor import _evaluate_graph
+    from ..ops import registry as reg
 
-    arg_names = root.list_arguments() + root.list_auxiliary_states()
-    missing = [n for n in arg_names if n not in known_shapes]
-    # pull shapes recorded on var attrs
-    for node in root._topo():
-        if node._op is None and node._name in missing:
+    for node in root._topo():  # shapes recorded on var attrs
+        if node._op is None and node._name not in known_shapes:
             s = node._attrs.get("__shape__")
             if s and all(d > 0 for d in s):
-                known_shapes[node._name] = s
-                missing.remove(node._name)
-    if missing:
-        # try local propagation for common layer params by evaluating
-        # progressively is complex; report unknown
-        return (None, None, None)
+                known_shapes[node._name] = tuple(s)
 
-    structs = {
-        n: jax.ShapeDtypeStruct(tuple(known_shapes[n]), jnp.float32)
-        for n in arg_names
-    }
+    nodes = [n for n in root._topo()]
+    node_out = {}  # id(node) -> tuple of output shapes
 
-    def fn(arg_dict):
-        outs = _evaluate_graph(root, arg_dict, training=False)
-        return outs
+    def in_shape(node, i):
+        inp = node._inputs[i]
+        if inp._op is None:
+            return known_shapes.get(inp._name)
+        shapes = node_out.get(id(inp))
+        if shapes is None:
+            return None
+        return shapes[inp._index] if inp._num_outputs > 1 else shapes[0]
 
-    out_struct = jax.eval_shape(fn, structs)
-    out_shapes = [tuple(o.shape) for o in out_struct]
-    arg_out = {n: tuple(known_shapes[n]) for n in root.list_arguments()}
-    aux_out = {n: tuple(known_shapes[n]) for n in root.list_auxiliary_states()}
+    for _ in range(len(nodes) + 2):  # fixed point
+        progress = False
+        for node in nodes:
+            if node._op in (None, "_group"):
+                continue
+            ins = [in_shape(node, i) for i in range(len(node._inputs))]
+            # 1) deduce unknown variable inputs
+            for pos, shp in _infer_params_for_node(node, ins).items():
+                inp = node._inputs[pos]
+                if inp._op is None and known_shapes.get(inp._name) is None:
+                    known_shapes[inp._name] = tuple(shp)
+                    progress = True
+            ins = [in_shape(node, i) for i in range(len(node._inputs))]
+            # 2) forward abstract eval when all inputs known
+            if id(node) not in node_out and all(s is not None for s in ins):
+                if node._op == "_full_scalar":
+                    node_out[id(node)] = [()]
+                    progress = True
+                    continue
+                if node._op == "_zeros_const":
+                    node_out[id(node)] = [tuple(node._attrs["shape"])]
+                    progress = True
+                    continue
+                try:
+                    opdef = reg.get(node._op)
+                except KeyError:
+                    continue
+                attrs = {k: v for k, v in node._attrs.items()
+                         if not k.startswith("__")}
+                structs = [jax.ShapeDtypeStruct(tuple(s), jnp.float32)
+                           for s in ins]
+                try:
+                    if node._op == "BatchNorm":
+                        attrs = dict(attrs)
+                        attrs["training"] = False
+                    res = jax.eval_shape(
+                        lambda *xs, _f=opdef.fn, _a=attrs: _f(*xs, **_a),
+                        *structs)
+                except Exception:
+                    continue
+                if isinstance(res, (tuple, list)):
+                    node_out[id(node)] = [tuple(r.shape) for r in res]
+                else:
+                    node_out[id(node)] = [tuple(res.shape)]
+                progress = True
+        if not progress:
+            break
+
+    heads = root._inputs if root._op == "_group" else [root]
+    out_shapes = []
+    for h in heads:
+        if h._op is None:
+            out_shapes.append(known_shapes.get(h._name))
+        else:
+            shapes = node_out.get(id(h))
+            if shapes is None:
+                out_shapes.append(None)
+            else:
+                out_shapes.append(shapes[h._index]
+                                  if h._num_outputs > 1 else shapes[0])
+    arg_out = {n: known_shapes.get(n) for n in root.list_arguments()}
+    aux_out = {n: known_shapes.get(n) for n in root.list_auxiliary_states()}
     return out_shapes, arg_out, aux_out

@@ -1,0 +1,76 @@
+"""Async-error semantics tests (reference model: test_exc_handling.py —
+exceptions surface at sync points; SURVEY.md §5.3)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def test_shape_error_is_eager():
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b).wait_to_read()
+
+
+def test_invalid_op_param():
+    with pytest.raises(Exception):
+        mx.nd.Activation(mx.nd.ones((2,)), act_type="not_a_thing")
+
+
+def test_uninitialized_param_message():
+    net = gluon.nn.Dense(3, in_units=2)
+    with pytest.raises(mx.MXNetError, match="initialize"):
+        net(mx.nd.ones((1, 2)))
+
+
+def test_deferred_init_message():
+    p = gluon.Parameter("w", shape=(3, 0), allow_deferred_init=True)
+    p.initialize()
+    with pytest.raises(gluon.DeferredInitializationError, match="deferred"):
+        p.data()
+
+
+def test_backward_without_record():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    y = x * 2
+    with pytest.raises(mx.MXNetError, match="tape"):
+        y.backward()
+
+
+def test_nan_propagates_not_raises():
+    # like the reference: NaN is data, not an error
+    x = mx.nd.array([0.0])
+    y = mx.nd.log(x)  # -inf
+    z = y - y          # nan
+    assert np.isnan(z.asnumpy()).all()
+
+
+def test_waitall_after_error_recovers():
+    try:
+        mx.nd.dot(mx.nd.ones((2, 3)), mx.nd.ones((4, 5))).asnumpy()
+    except Exception:
+        pass
+    mx.nd.waitall()  # framework still usable
+    assert mx.nd.ones((2,)).sum().asscalar() == 2.0
+
+
+def test_sync_exec_env_flag():
+    from mxnet_tpu import engine
+
+    assert engine.sync_exec_enabled() in (True, False)
+
+
+def test_exception_inside_hybridized_block():
+    class Bad(gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.reshape(x, shape=(999, 999))  # invalid reshape
+
+    b = Bad()
+    b.initialize()
+    b.hybridize()
+    with pytest.raises(Exception):
+        b(mx.nd.ones((2, 2)))

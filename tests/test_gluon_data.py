@@ -1,0 +1,144 @@
+"""gluon.data tests (reference model: test_gluon_data.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data import (
+    ArrayDataset,
+    BatchSampler,
+    DataLoader,
+    RandomSampler,
+    SequentialSampler,
+    SimpleDataset,
+)
+
+
+def test_array_dataset():
+    X = np.arange(20).reshape(10, 2)
+    Y = np.arange(10)
+    ds = ArrayDataset(X, Y)
+    assert len(ds) == 10
+    x, y = ds[3]
+    np.testing.assert_array_equal(x, [6, 7])
+    assert y == 3
+
+
+def test_dataset_transform():
+    ds = SimpleDataset(list(range(5))).transform(lambda x: x * 2)
+    assert ds[2] == 4
+    ds2 = ArrayDataset(np.arange(4), np.arange(4)).transform_first(
+        lambda x: x + 100)
+    x, y = ds2[1]
+    assert x == 101 and y == 1
+
+
+def test_dataset_filter_shard_take():
+    ds = SimpleDataset(list(range(10)))
+    f = ds.filter(lambda x: x % 2 == 0)
+    assert len(f) == 5
+    s0 = ds.shard(3, 0)
+    s1 = ds.shard(3, 1)
+    s2 = ds.shard(3, 2)
+    assert len(s0) + len(s1) + len(s2) == 10
+    assert len(ds.take(4)) == 4
+
+
+def test_samplers():
+    assert list(SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    r = list(RandomSampler(100))
+    assert sorted(r) == list(range(100))
+    bs = BatchSampler(SequentialSampler(7), 3, "keep")
+    assert [len(b) for b in bs] == [3, 3, 1]
+    bs = BatchSampler(SequentialSampler(7), 3, "discard")
+    assert [len(b) for b in bs] == [3, 3]
+    bs = BatchSampler(SequentialSampler(7), 3, "rollover")
+    assert [len(b) for b in bs] == [3, 3]
+    assert [len(b) for b in bs] == [3, 3]  # rolled-over 1 + 7 = 8 -> 2x3
+
+
+def test_dataloader_single_process():
+    X = np.random.rand(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=4, last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    assert batches[0][0].shape == (4, 3)
+    assert batches[2][0].shape == (2, 3)
+    assert isinstance(batches[0][0], mx.NDArray)
+
+
+def test_dataloader_shuffle():
+    X = np.arange(100).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, X), batch_size=100, shuffle=True)
+    (x, _), = list(loader)
+    assert not np.array_equal(x.asnumpy(), np.arange(100))
+    assert sorted(x.asnumpy().tolist()) == list(range(100))
+
+
+def test_dataloader_multiworker():
+    X = np.random.rand(12, 3).astype(np.float32)
+    Y = np.arange(12).astype(np.float32)
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=4, num_workers=2)
+    total = 0
+    seen = []
+    for x, y in loader:
+        total += x.shape[0]
+        seen.extend(y.asnumpy().tolist())
+    assert total == 12
+    assert sorted(seen) == list(range(12))
+    # second epoch works
+    assert sum(x.shape[0] for x, _ in loader) == 12
+
+
+def test_dataloader_batchify_fn():
+    def batchify(samples):
+        xs = [s for s in samples]
+        return mx.nd.array(np.stack(xs))
+
+    loader = DataLoader(SimpleDataset([np.ones(2, np.float32) * i
+                                       for i in range(6)]),
+                        batch_size=2, batchify_fn=batchify)
+    b = next(iter(loader))
+    assert b.shape == (2, 2)
+
+
+def test_record_file_dataset(tmp_path):
+    from mxnet_tpu import recordio
+
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    for i in range(5):
+        w.write_idx(i, f"data{i}".encode())
+    w.close()
+    ds = gluon.data.RecordFileDataset(rec)
+    assert len(ds) == 5
+    assert ds[2] == b"data2"
+
+
+def test_transforms_compose():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    t = transforms.Compose([transforms.ToTensor(),
+                            transforms.Normalize(0.5, 0.5)])
+    img = mx.nd.array((np.random.rand(8, 8, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    out = t(img)
+    assert out.shape == (3, 8, 8)
+    assert out.asnumpy().min() >= -1.001 and out.asnumpy().max() <= 1.001
+
+
+def test_transforms_geometric():
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = mx.nd.array((np.random.rand(30, 40, 3) * 255).astype(np.uint8),
+                      dtype="uint8")
+    assert transforms.Resize(16)(img).shape == (16, 16, 3)
+    assert transforms.CenterCrop(20)(img).shape == (20, 20, 3)
+    assert transforms.RandomResizedCrop(14)(img).shape == (14, 14, 3)
+    assert transforms.RandomFlipLeftRight(1.0)(img).shape == (30, 40, 3)
+    np.testing.assert_array_equal(
+        transforms.RandomFlipLeftRight(1.0)(img).asnumpy(),
+        img.asnumpy()[:, ::-1])

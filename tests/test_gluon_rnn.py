@@ -1,0 +1,122 @@
+"""gluon.rnn tests (reference model: test_gluon_rnn.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+def test_lstm_layer_shapes():
+    layer = rnn.LSTM(hidden_size=10, num_layers=2)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(5, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 10)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 10)
+    assert new_states[0].shape == (2, 3, 10)
+    assert new_states[1].shape == (2, 3, 10)
+
+
+def test_gru_rnn_layers():
+    for layer in (rnn.GRU(hidden_size=6), rnn.RNN(hidden_size=6,
+                                                  activation="tanh")):
+        layer.initialize()
+        out = layer(mx.nd.random.normal(shape=(4, 2, 5)))
+        assert out.shape == (4, 2, 6)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(hidden_size=7, bidirectional=True)
+    layer.initialize()
+    out = layer(mx.nd.random.normal(shape=(4, 2, 5)))
+    assert out.shape == (4, 2, 14)
+
+
+def test_ntc_layout():
+    layer = rnn.LSTM(hidden_size=4, layout="NTC")
+    layer.initialize()
+    out = layer(mx.nd.random.normal(shape=(2, 6, 3)))
+    assert out.shape == (2, 6, 4)
+
+
+def test_lstm_gradient_flow():
+    layer = rnn.LSTM(hidden_size=5)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(3, 2, 4))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    for p in layer.collect_params().values():
+        if p.grad_req != "null":
+            assert np.isfinite(p.grad().asnumpy()).all()
+
+
+def test_lstm_cell_unroll():
+    cell = rnn.LSTMCell(hidden_size=6, input_size=4)
+    cell.initialize()
+    x = mx.nd.random.normal(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 6)
+    assert states[0].shape == (2, 6)
+
+
+def test_cell_fused_consistency():
+    """Unfused LSTMCell.unroll must match the fused LSTM layer."""
+    T, N, C, H = 4, 2, 3, 5
+    fused = rnn.LSTM(hidden_size=H, input_size=C, prefix="l_")
+    fused.initialize()
+    cell = rnn.LSTMCell(hidden_size=H, input_size=C, prefix="c_")
+    cell.initialize()
+    # copy fused params into the cell
+    fp = {k.split("l_")[-1]: v for k, v in fused.collect_params().items()}
+    cp = cell.collect_params()
+    cp["c_i2h_weight"].set_data(fp["l0_i2h_weight"].data())
+    cp["c_h2h_weight"].set_data(fp["l0_h2h_weight"].data())
+    cp["c_i2h_bias"].set_data(fp["l0_i2h_bias"].data())
+    cp["c_h2h_bias"].set_data(fp["l0_h2h_bias"].data())
+
+    x = mx.nd.random.normal(shape=(T, N, C))
+    out_fused = fused(x).asnumpy()
+    outs, _ = cell.unroll(T, [x[t] for t in range(T)], layout="TNC",
+                          merge_outputs=False)
+    out_cell = np.stack([o.asnumpy() for o in outs])
+    np.testing.assert_allclose(out_fused, out_cell, rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_rnn_cell():
+    seq = rnn.SequentialRNNCell()
+    seq.add(rnn.LSTMCell(4, input_size=3))
+    seq.add(rnn.GRUCell(5, input_size=4))
+    seq.initialize()
+    states = seq.begin_state(batch_size=2)
+    out, new_states = seq(mx.nd.random.normal(shape=(2, 3)), states)
+    assert out.shape == (2, 5)
+    assert len(new_states) == 3  # 2 lstm + 1 gru
+
+
+def test_residual_and_dropout_cells():
+    cell = rnn.ResidualCell(rnn.RNNCell(4, input_size=4))
+    cell.initialize()
+    x = mx.nd.random.normal(shape=(2, 4))
+    out, _ = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 4)
+    dc = rnn.DropoutCell(0.5)
+    out2, _ = dc(x, [])
+    assert out2.shape == (2, 4)
+
+
+def test_hybridized_lstm():
+    layer = rnn.LSTM(hidden_size=6, input_size=5)
+    layer.initialize()
+    x = mx.nd.random.normal(shape=(3, 2, 5))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    out = layer(x).asnumpy()
+    np.testing.assert_allclose(ref, out, rtol=1e-4, atol=1e-5)

@@ -1,0 +1,114 @@
+"""Module API tests (reference model: test_module.py + train/test_mlp.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp_symbol(num_hidden=16, classes=3):
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, sym.var("fc1_weight"), sym.var("fc1_bias"),
+                             num_hidden=num_hidden, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, sym.var("fc2_weight"), sym.var("fc2_bias"),
+                             num_hidden=classes, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.var("softmax_label"), name="softmax")
+
+
+def _toy_data(n=240, dim=10, classes=3, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, dim).astype(np.float32)
+    W = rng.randn(dim, classes).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+    return X, Y
+
+
+def test_module_fit_convergence():
+    """End-to-end Module.fit (the reference's train/test_mlp.py pattern)."""
+    X, Y = _toy_data()
+    train_iter = mx.io.NDArrayIter(X, Y, batch_size=40, shuffle=True)
+    mod = mx.mod.Module(_mlp_symbol(), context=mx.cpu())
+    mod.fit(train_iter, num_epoch=25,
+            optimizer_params={"learning_rate": 0.5},
+            initializer=mx.initializer.Xavier())
+    score = mod.score(mx.io.NDArrayIter(X, Y, batch_size=40), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.9, f"Module.fit failed to converge: {acc}"
+
+
+def test_module_forward_backward_update():
+    X, Y = _toy_data(n=40)
+    it = mx.io.NDArrayIter(X, Y, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    batch = next(iter(it))
+    mod.forward(batch, is_train=True)
+    out = mod.get_outputs()[0]
+    assert out.shape == (20, 3)
+    w_before = mod.get_params()[0]["fc1_weight"].asnumpy().copy()
+    mod.backward()
+    mod.update()
+    w_after = mod.get_params()[0]["fc1_weight"].asnumpy()
+    assert not np.allclose(w_before, w_after)
+
+
+def test_module_predict():
+    X, Y = _toy_data(n=60)
+    it = mx.io.NDArrayIter(X, Y, batch_size=30)
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    preds = mod.predict(it)
+    assert preds.shape == (60, 3)
+
+
+def test_module_checkpoint(tmp_path):
+    X, Y = _toy_data(n=40)
+    it = mx.io.NDArrayIter(X, Y, batch_size=20)
+    mod = mx.mod.Module(_mlp_symbol())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.initializer.Xavier())
+    prefix = str(tmp_path / "mlp")
+    mod.save_checkpoint(prefix, 3)
+    symbol, arg_params, aux_params = mx.module.load_checkpoint(prefix, 3)
+    assert "fc1_weight" in arg_params
+    mod2 = mx.mod.Module(symbol)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod2.set_params(arg_params, aux_params)
+    batch = next(iter(it))
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    assert_almost_equal(mod.get_outputs()[0],
+                        mod2.get_outputs()[0].asnumpy())
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = sym.var("data")
+        fc = sym.FullyConnected(data, sym.var("w"), sym.var("b"),
+                                num_hidden=4)
+        out = sym.SoftmaxOutput(fc, sym.var("softmax_label"))
+        return out, ["data"], ["softmax_label"]
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=8)
+    X = np.random.rand(16, 8).astype(np.float32)
+    Y = np.zeros(16, np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=8)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer()
+    batch = next(iter(it))
+    batch.bucket_key = 8
+    batch.provide_data = it.provide_data
+    batch.provide_label = it.provide_label
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape == (8, 4)

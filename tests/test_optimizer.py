@@ -1,0 +1,132 @@
+"""Optimizer tests (reference model: test_optimizer.py update-rule checks)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.optimizer import (
+    SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta, RMSProp, Ftrl, FTML, LAMB,
+    LARS, Signum, DCASGD, create, get_updater,
+)
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_steps(opt, steps=3, shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = mx.nd.array(rng.randn(*shape).astype(np.float32))
+    state = opt.create_state_multi_precision(0, w)
+    ws = [w.asnumpy().copy()]
+    for _ in range(steps):
+        g = mx.nd.array(rng.randn(*shape).astype(np.float32))
+        opt.update_multi_precision(0, w, g, state)
+        ws.append(w.asnumpy().copy())
+    return ws
+
+
+def test_sgd_momentum_formula():
+    opt = SGD(learning_rate=0.1, momentum=0.9, wd=0.0, rescale_grad=1.0)
+    w = mx.nd.array([1.0])
+    state = opt.create_state(0, w)
+    g = mx.nd.array([0.5])
+    opt.update(0, w, g, state)
+    # mom = -0.1*0.5 = -0.05; w = 0.95
+    assert_almost_equal(w, np.array([0.95], np.float32))
+    opt.update(0, w, g, state)
+    # mom = 0.9*-0.05 - 0.05 = -0.095; w = 0.855
+    assert_almost_equal(w, np.array([0.855], np.float32))
+
+
+def test_sgd_wd():
+    opt = SGD(learning_rate=0.1, wd=0.1, rescale_grad=1.0)
+    w = mx.nd.array([1.0])
+    opt.update(0, w, mx.nd.array([0.0]), None)
+    assert_almost_equal(w, np.array([0.99], np.float32))  # 1 - 0.1*0.1*1
+
+
+def test_adam_first_step():
+    opt = Adam(learning_rate=0.001, rescale_grad=1.0)
+    w = mx.nd.array([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, mx.nd.array([1.0]), state)
+    # first adam step moves by ~lr regardless of grad magnitude
+    assert abs(float(w.asscalar()) - (1.0 - 0.001)) < 1e-5
+
+
+def test_all_optimizers_decrease_quadratic():
+    for cls, kwargs in [
+        (SGD, {"learning_rate": 0.1}),
+        (SGD, {"learning_rate": 0.1, "momentum": 0.9}),
+        (NAG, {"learning_rate": 0.1, "momentum": 0.9}),
+        (Adam, {"learning_rate": 0.1}),
+        (AdamW, {"learning_rate": 0.1, "wd": 0.01}),
+        (AdaGrad, {"learning_rate": 0.5}),
+        (AdaDelta, {}),
+        (RMSProp, {"learning_rate": 0.05}),
+        (RMSProp, {"learning_rate": 0.05, "centered": True}),
+        (Ftrl, {"learning_rate": 0.5}),
+        (FTML, {"learning_rate": 0.1}),
+        (LAMB, {"learning_rate": 0.05}),
+        (LARS, {"learning_rate": 0.5}),
+        (Signum, {"learning_rate": 0.01}),
+        (DCASGD, {"learning_rate": 0.1}),
+    ]:
+        opt = cls(rescale_grad=1.0, **kwargs)
+        w = mx.nd.array([3.0])
+        state = opt.create_state_multi_precision(0, w)
+        # minimize f(w) = w^2 / 2; grad = w — every rule must descend
+        # (fixed-step rules like Signum/LARS descend slowly by design)
+        for _ in range(50):
+            g = mx.nd.array([float(w.asscalar())])
+            opt.update_multi_precision(0, w, g, state)
+        final = abs(float(w.asscalar()))
+        assert final < 2.95, f"{cls.__name__} did not descend: {final}"
+
+
+def test_multi_precision_fp16():
+    opt = SGD(learning_rate=0.1, momentum=0.9, multi_precision=True,
+              rescale_grad=1.0)
+    w = mx.nd.array([1.0]).astype("float16")
+    state = opt.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master.dtype == np.float32
+    opt.update_multi_precision(0, w, mx.nd.array([0.5]).astype("float16"),
+                               state)
+    assert w.dtype == np.float16
+    assert abs(float(w.asscalar()) - 0.95) < 1e-3
+
+
+def test_clip_gradient():
+    opt = SGD(learning_rate=1.0, clip_gradient=0.1, rescale_grad=1.0)
+    w = mx.nd.array([0.0])
+    opt.update(0, w, mx.nd.array([100.0]), None)
+    assert_almost_equal(w, np.array([-0.1], np.float32))
+
+
+def test_lr_scheduler_in_optimizer():
+    sched = mx.lr_scheduler.MultiFactorScheduler(step=[2, 4], factor=0.1)
+    opt = SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = mx.nd.array([0.0])
+    for i in range(6):
+        opt.update(0, w, mx.nd.array([0.0]), None)
+    assert opt.learning_rate < 1.0
+
+
+def test_create_registry():
+    assert isinstance(create("sgd"), SGD)
+    assert isinstance(create("adam", learning_rate=0.1), Adam)
+    with pytest.raises(mx.MXNetError):
+        create("definitely_not_an_optimizer")
+
+
+def test_updater():
+    upd = get_updater(SGD(learning_rate=0.1, rescale_grad=1.0))
+    w = mx.nd.array([1.0])
+    upd(0, mx.nd.array([1.0]), w)
+    assert_almost_equal(w, np.array([0.9], np.float32))
+
+
+def test_lr_wd_mult():
+    opt = SGD(learning_rate=1.0, rescale_grad=1.0)
+    opt.set_lr_mult({0: 0.1})
+    assert opt._get_lr(0) == pytest.approx(0.1)
+    assert opt._get_lr(1) == pytest.approx(1.0)

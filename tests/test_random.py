@@ -1,0 +1,73 @@
+"""Random API tests (reference model: test_random.py distribution checks)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_seed_reproducible():
+    mx.random.seed(42)
+    a = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    assert not np.allclose(b, c)
+
+
+def test_uniform_range():
+    x = mx.nd.random.uniform(2.0, 5.0, shape=(10000,)).asnumpy()
+    assert x.min() >= 2.0 and x.max() < 5.0
+    assert abs(x.mean() - 3.5) < 0.1
+
+
+def test_normal_moments():
+    x = mx.nd.random.normal(1.0, 2.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.1
+    assert abs(x.std() - 2.0) < 0.1
+
+
+def test_randint():
+    x = mx.nd.random.randint(0, 10, shape=(5000,)).asnumpy()
+    assert x.min() >= 0 and x.max() <= 9
+    assert x.dtype == np.int32
+    assert len(np.unique(x)) == 10
+
+
+def test_gamma_exponential_poisson():
+    g = mx.nd.random.gamma(2.0, 2.0, shape=(5000,)).asnumpy()
+    assert abs(g.mean() - 4.0) < 0.3  # mean = alpha*beta
+    e = mx.nd.random.exponential(2.0, shape=(5000,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.2
+    p = mx.nd.random.poisson(3.0, shape=(5000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.2
+
+
+def test_multinomial():
+    probs = mx.nd.array([0.1, 0.0, 0.9])
+    s = mx.nd.random.multinomial(probs, shape=5000).asnumpy()
+    assert set(np.unique(s)) <= {0, 2}
+    assert (s == 2).mean() > 0.8
+    # batched + get_prob
+    bprobs = mx.nd.array([[1.0, 0.0], [0.0, 1.0]])
+    s2, lp = mx.nd.random.multinomial(bprobs, get_prob=True)
+    assert s2.shape == (2,)
+    np.testing.assert_array_equal(s2.asnumpy(), [0, 1])
+
+
+def test_shuffle():
+    x = mx.nd.arange(0, 100)
+    y = mx.nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(100))
+    assert not np.array_equal(y, np.arange(100))
+
+
+def test_dropout_uses_key_stream():
+    from mxnet_tpu import autograd
+
+    mx.random.seed(0)
+    with autograd.record():
+        a = mx.nd.Dropout(mx.nd.ones((50, 50)), p=0.5).asnumpy()
+        b = mx.nd.Dropout(mx.nd.ones((50, 50)), p=0.5).asnumpy()
+    assert not np.allclose(a, b)  # distinct draws from the stream

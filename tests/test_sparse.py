@@ -1,0 +1,130 @@
+"""Sparse NDArray tests (reference model: test_sparse_ndarray.py) +
+the factorization-machine path (BASELINE config #4)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = sparse.cast_storage(mx.nd.array(dense), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [1, 4])
+    assert_almost_equal(rsp.tostype("default"), dense)
+    # dense ops work directly on the sparse handle
+    assert_almost_equal((rsp * 2).asnumpy(), dense * 2)
+
+
+def test_csr_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    csr = sparse.cast_storage(mx.nd.array(dense), "csr")
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.tostype("default"), dense)
+    np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3])
+    np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+
+
+def test_row_sparse_array_ctor():
+    rsp = sparse.row_sparse_array(
+        (np.ones((2, 4), np.float32), [1, 3]), shape=(5, 4))
+    assert rsp.shape == (5, 4)
+    d = rsp.tostype("default").asnumpy()
+    assert d[1].sum() == 4 and d[3].sum() == 4 and d[0].sum() == 0
+
+
+def test_csr_matrix_ctor():
+    csr = sparse.csr_matrix(
+        (np.array([1.0, 2.0], np.float32), [0, 2], [0, 1, 2]), shape=(2, 3))
+    d = csr.tostype("default").asnumpy()
+    assert d[0, 0] == 1.0 and d[1, 2] == 2.0
+
+
+def test_retain():
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    rsp = sparse.cast_storage(mx.nd.array(dense), "row_sparse")
+    kept = sparse.retain(rsp, mx.nd.array([0, 2]))
+    d = kept.tostype("default").asnumpy()
+    np.testing.assert_array_equal(d[1], 0)
+    np.testing.assert_array_equal(d[0], dense[0])
+    np.testing.assert_array_equal(d[2], dense[2])
+
+
+def test_sparse_dot():
+    dense = np.random.rand(4, 5).astype(np.float32)
+    w = np.random.rand(5, 2).astype(np.float32)
+    csr = sparse.cast_storage(mx.nd.array(dense), "csr")
+    out = sparse.dot(csr, mx.nd.array(w))
+    assert_almost_equal(out, dense @ w, rtol=1e-5)
+
+
+def test_sparse_embedding_grad_and_kvstore():
+    """The FM training pattern: sparse embedding grads + row_sparse_pull."""
+    from mxnet_tpu import autograd, gluon
+
+    emb = gluon.contrib.nn.SparseEmbedding(20, 4)
+    emb.initialize()
+    idx = mx.nd.array([1.0, 5.0, 5.0])
+    with autograd.record():
+        out = emb(idx)
+        loss = out.sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert g[1].sum() == pytest.approx(4.0)
+    assert g[5].sum() == pytest.approx(8.0)  # appears twice
+    assert g[0].sum() == 0
+
+    kv = mx.kv.create("local")
+    kv.init("emb", emb.weight.data())
+    out_buf = mx.nd.zeros((20, 4))
+    kv.row_sparse_pull("emb", out=out_buf, row_ids=mx.nd.array([1, 5]))
+    assert out_buf.asnumpy()[2].sum() == 0
+    assert_almost_equal(out_buf.asnumpy()[1], emb.weight.data().asnumpy()[1])
+
+
+def test_factorization_machine_convergence():
+    """Tiny FM on synthetic sparse data (BASELINE config #4)."""
+    from mxnet_tpu import autograd, gluon
+
+    rng = np.random.RandomState(3)
+    n, num_feat, k = 200, 30, 4
+    # each sample activates 3 features
+    feats = rng.randint(0, num_feat, (n, 3)).astype(np.float32)
+    true_w = rng.randn(num_feat).astype(np.float32)
+    y = (true_w[feats.astype(int)].sum(1) > 0).astype(np.float32)
+
+    class FM(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.w = gluon.contrib.nn.SparseEmbedding(num_feat, 1,
+                                                          prefix="w_")
+                self.v = gluon.contrib.nn.SparseEmbedding(num_feat, k,
+                                                          prefix="v_")
+
+        def hybrid_forward(self, F, x):
+            linear = self.w(x).sum(axis=1).reshape((-1,))
+            vecs = self.v(x)  # (N, 3, k)
+            sum_sq = F.square(vecs.sum(axis=1)).sum(axis=1)
+            sq_sum = F.square(vecs).sum(axis=2).sum(axis=1)
+            return linear + 0.5 * (sum_sq - sq_sum)
+
+    net = FM()
+    net.initialize(init=mx.initializer.Normal(0.1))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.SigmoidBCELoss()
+    X, Y = mx.nd.array(feats), mx.nd.array(y)
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(X), Y)
+        l.backward()
+        trainer.step(n)
+    pred = (net(X).sigmoid().asnumpy() > 0.5).astype(np.float32)
+    acc = (pred == y).mean()
+    assert acc > 0.85, f"FM failed to converge: {acc}"

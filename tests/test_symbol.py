@@ -1,0 +1,107 @@
+"""Symbol API tests (reference model: test_symbol.py + test_executor.py)."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_variable_and_compose():
+    x = sym.var("x")
+    y = sym.var("y")
+    z = x + y
+    assert set(z.list_arguments()) == {"x", "y"}
+    assert z.list_outputs()[0].endswith("_output")
+
+
+def test_symbol_eval():
+    x = sym.var("x")
+    y = sym.FullyConnected(x, sym.var("w"), sym.var("b"), num_hidden=3)
+    out = y.eval(x=mx.nd.ones((2, 4)),
+                 w=mx.nd.ones((3, 4)),
+                 b=mx.nd.zeros((3,)))[0]
+    assert_almost_equal(out, np.full((2, 3), 4.0, np.float32))
+
+
+def test_infer_shape():
+    x = sym.var("x")
+    w = sym.var("w")
+    b = sym.var("b")
+    y = sym.FullyConnected(x, w, b, num_hidden=5)
+    arg_shapes, out_shapes, _ = y.infer_shape(x=(2, 3), w=(5, 3), b=(5,))
+    assert out_shapes == [(2, 5)]
+
+
+def test_simple_bind_forward_backward():
+    x = sym.var("x")
+    w = sym.var("w")
+    y = sym.FullyConnected(x, w, None, num_hidden=2, no_bias=True)
+    loss = sym.sum(y * y)
+    exe = loss.simple_bind(ctx=mx.cpu(), x=(3, 4), w=(2, 4))
+    exe.arg_dict["x"]._set_data(np.ones((3, 4), np.float32))
+    exe.arg_dict["w"]._set_data(np.full((2, 4), 0.5, np.float32))
+    (out,) = exe.forward(is_train=True)
+    # y = 2.0 everywhere (3x2); loss = 24
+    assert out.asscalar() == pytest.approx(24.0)
+    exe.backward()
+    # dL/dw = sum over batch of 2*y*x = 2*2*1 summed over 3 rows = 12
+    assert_almost_equal(exe.grad_dict["w"], np.full((2, 4), 12.0, np.float32))
+
+
+def test_tojson_load_roundtrip(tmp_path):
+    x = sym.var("data")
+    y = sym.Activation(sym.FullyConnected(
+        x, sym.var("w"), sym.var("b"), num_hidden=4), act_type="relu")
+    f = str(tmp_path / "net.json")
+    y.save(f)
+    y2 = sym.load(f)
+    assert set(y2.list_arguments()) == set(y.list_arguments())
+    args = dict(data=mx.nd.ones((1, 3)), w=mx.nd.ones((4, 3)),
+                b=mx.nd.zeros((4,)))
+    o1 = y.eval(**args)[0]
+    o2 = y2.eval(**args)[0]
+    assert_almost_equal(o1, o2.asnumpy())
+
+
+def test_multi_output_split():
+    x = sym.var("x")
+    parts = sym.split(x, num_outputs=2, axis=1)
+    assert len(parts) == 2
+    o = parts[1].eval(x=mx.nd.array(np.arange(8).reshape(2, 4)))[0]
+    assert_almost_equal(o, np.array([[2, 3], [6, 7]], np.float32))
+
+
+def test_symbol_arithmetic():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = (a * 2 + b) / 4
+    out = c.eval(a=mx.nd.array([2.0]), b=mx.nd.array([4.0]))[0]
+    assert out.asscalar() == pytest.approx(2.0)
+
+
+def test_group():
+    a = sym.var("a")
+    g = sym.Group([a * 2, a + 1])
+    outs = g.eval(a=mx.nd.array([3.0]))
+    assert outs[0].asscalar() == pytest.approx(6.0)
+    assert outs[1].asscalar() == pytest.approx(4.0)
+
+
+def test_batchnorm_aux_states():
+    x = sym.var("x")
+    bn = sym.BatchNorm(x, sym.var("gamma"), sym.var("beta"),
+                       sym.var("moving_mean", __aux__=True),
+                       sym.var("moving_var", __aux__=True))
+    assert "moving_mean" in bn.list_auxiliary_states()
+    assert "moving_mean" not in bn.list_arguments()
+
+
+def test_get_internals():
+    x = sym.var("x")
+    h = sym.FullyConnected(x, sym.var("w"), None, num_hidden=3, no_bias=True,
+                           name="fc1")
+    y = sym.relu(h, name="act")
+    internals = y.get_internals()
+    assert any("fc1" in str(s.name) for s in internals._inputs)
