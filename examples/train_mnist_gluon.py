@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Gluon MNIST training (reference: ``example/gluon/mnist.py`` — BASELINE
+config #1, the hybridize() smoke test).
+
+Runs on real MNIST idx files if present under --data-dir, otherwise on a
+synthetic drop-in (zero-egress environment), exercising the identical code
+path: DataLoader -> hybridized net -> autograd -> Trainer -> Speedometer.
+"""
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+def get_data(data_dir, batch_size):
+    try:
+        train = gluon.data.vision.MNIST(root=data_dir, train=True)
+        val = gluon.data.vision.MNIST(root=data_dir, train=False)
+        print("using real MNIST from", data_dir)
+    except mx.MXNetError:
+        print("MNIST files not found; using synthetic stand-in")
+        rng = np.random.RandomState(0)
+        imgs = (rng.rand(2048, 28, 28, 1) * 255).astype(np.uint8)
+        labels = rng.randint(0, 10, (2048,)).astype(np.int32)
+        # make classes separable so accuracy is meaningful
+        for i in range(2048):
+            imgs[i, labels[i] * 2:labels[i] * 2 + 3] = 255
+        train = gluon.data.ArrayDataset(mx.nd.array(imgs, dtype="uint8"),
+                                        labels.astype(np.float32))
+        val = train
+
+    def tf(data, label):
+        return (mx.nd.array(data).astype("float32") / 255.0, label)
+
+    train = train.transform(tf) if not isinstance(train, gluon.data.ArrayDataset) else train
+    return (gluon.data.DataLoader(train, batch_size, shuffle=True),
+            gluon.data.DataLoader(val, batch_size))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=0.02)
+    parser.add_argument("--data-dir", type=str,
+                        default=os.path.join("~", ".mxnet", "datasets", "mnist"))
+    parser.add_argument("--no-hybridize", action="store_true")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    ctx = mx.tpu() if mx.num_tpus() else mx.cpu()
+    train_loader, val_loader = get_data(args.data_dir, args.batch_size)
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(256, activation="relu"),
+            gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(init=mx.initializer.Xavier(), ctx=ctx)
+    if not args.no_hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for data, label in train_loader:
+            data = data.as_in_context(ctx).reshape((data.shape[0], -1))
+            label = label if isinstance(label, mx.NDArray) else mx.nd.array(label)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update([label], [out])
+            n += data.shape[0]
+        name, acc = metric.get()
+        logging.info("Epoch[%d] Train-%s=%.4f  Speed: %.1f samples/sec",
+                     epoch, name, acc, n / (time.time() - tic))
+
+    metric.reset()
+    for data, label in val_loader:
+        data = data.as_in_context(ctx).reshape((data.shape[0], -1))
+        label = label if isinstance(label, mx.NDArray) else mx.nd.array(label)
+        metric.update([label], [net(data)])
+    logging.info("Validation-%s=%.4f", *metric.get())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""ResNet data-parallel training over a device mesh (reference:
+``example/image-classification/train_imagenet.py`` reimagined SPMD —
+SURVEY.md §2.5 P1/P2/P4 collapse into one psum inside the fused step).
+
+Feeds from a RecordIO pack via the C++ pipeline when --rec is given,
+synthetic batches otherwise.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50_v1")
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--classes", type=int, default=1000)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--dtype", default="bfloat16")
+    parser.add_argument("--rec", default=None, help="RecordIO pack path")
+    args = parser.parse_args()
+
+    import jax
+
+    ndev = len(jax.devices())
+    mesh = parallel.make_mesh({"dp": ndev}) if ndev > 1 else None
+    print(f"devices={ndev} mesh={'dp=%d' % ndev if mesh else 'single'}")
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize(init=mx.initializer.Xavier())
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    step = parallel.SPMDTrainStep(net, loss_fn, "sgd",
+                                  {"momentum": 0.9, "wd": 1e-4}, mesh=mesh)
+
+    if args.rec:
+        it = mx.io.ImageRecordIter(
+            path_imgrec=args.rec, batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size), shuffle=True,
+            rand_crop=True, rand_mirror=True,
+            mean_r=123.68, mean_g=116.28, mean_b=103.53,
+            std_r=58.4, std_g=57.1, std_b=57.4)
+
+        def batches():
+            while True:
+                for b in it:
+                    yield b.data[0], b.label[0].reshape((-1,))
+                it.reset()
+    else:
+        x = mx.nd.random.normal(shape=(args.batch_size, 3, args.image_size,
+                                       args.image_size))
+        y = mx.nd.array(np.random.randint(0, args.classes,
+                                          (args.batch_size,)).astype(np.float32))
+
+        def batches():
+            while True:
+                yield x, y
+
+    gen = batches()
+    xb, yb = next(gen)
+    if args.dtype != "float32":
+        xb = xb.astype(args.dtype)
+    step(xb, yb, lr=args.lr)  # compile
+
+    tic = time.time()
+    for i in range(args.steps):
+        xb, yb = next(gen)
+        if args.dtype != "float32":
+            xb = xb.astype(args.dtype)
+        loss = step(xb, yb, lr=args.lr, sync=(i == args.steps - 1))
+    dt = time.time() - tic
+    print(f"loss={loss:.4f}  throughput="
+          f"{args.batch_size * args.steps / dt:.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

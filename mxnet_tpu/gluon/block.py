@@ -335,6 +335,42 @@ class HybridBlock(Block):
             "or override infer_shape()."
         )
 
+    # -- symbolic path (export / SymbolBlock interop) --------------------
+    def _symbolic_forward(self, *sym_args):
+        """Run hybrid_forward with Symbol inputs and param variables —
+        the reference's symbol-proxy trace (``_build_cache``), used by
+        ``export()``."""
+        from ..symbol import op as symF
+        from ..symbol.symbol import var as sym_var
+
+        kwargs = {}
+        for name, p in self._reg_params.items():
+            kwargs[name] = sym_var(p.name, shape=p.shape,
+                                   __aux__=p.grad_req == "null" or None)
+        return self.hybrid_forward(symF, *sym_args, **kwargs)
+
+    def export(self, path, epoch=0):
+        """Serialize to ``path-symbol.json`` + ``path-####.params``
+        (reference: ``HybridBlock.export`` — the deployment format,
+        loadable by ``SymbolBlock.imports``)."""
+        from ..ndarray import ndarray as nd
+        from ..symbol.symbol import Symbol, var as sym_var
+
+        data = sym_var("data")
+        out = self(data)
+        if isinstance(out, (list, tuple)):
+            from ..symbol.symbol import Group
+
+            out = Group(list(out))
+        out.save(f"{path}-symbol.json")
+        arg_dict = {}
+        params = self.collect_params()
+        for name, p in params.items():
+            prefix = "aux:" if p.grad_req == "null" else "arg:"
+            arg_dict[prefix + name] = p._data[next(iter(p._data))]
+        nd.save(f"{path}-{epoch:04d}.params", arg_dict)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
     # -- eager path ------------------------------------------------------
     def _resolve_params(self, args):
         ctx = None
@@ -363,6 +399,10 @@ class HybridBlock(Block):
         return self.hybrid_forward(F, *args, **params)
 
     def forward(self, *args):
+        from ..symbol.symbol import Symbol
+
+        if args and isinstance(args[0], Symbol):
+            return self._symbolic_forward(*args)
         if self._active and not _in_cached_trace():
             return self._call_cached(*args)
         return self._eager_forward(*args)

@@ -8,6 +8,9 @@ store lowers push/pull to an ICI allreduce (SURVEY.md §2.5 P2/P4).
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
 from .. import optimizer as opt
 from ..base import MXNetError
 from ..kvstore import create as _create_kvstore
@@ -140,7 +143,73 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
+    # -- fused update fast path ------------------------------------------
+    # One jitted executable updates every parameter per step (the analog of
+    # the reference's multi-tensor `multi_sgd` kernels) when the optimizer
+    # maps onto a pure pytree rule and every param lives on one device.
+    # (AdamW excluded: its decoupled wd differs from the shared adam rule)
+    _FUSABLE = {"sgd": ("momentum", "wd"),
+                "adam": ("beta1", "beta2", "epsilon", "wd"),
+                "lamb": ("beta1", "beta2", "epsilon", "wd")}
+
+    def _fused_setup(self):
+        if getattr(self, "_fused", None) is not None:
+            return self._fused
+        self._fused = False
+        name = type(self._optimizer).__name__.lower()
+        o = self._optimizer
+        if name not in self._FUSABLE or o.lr_scheduler is not None \
+                or o.clip_gradient is not None or o.multi_precision \
+                or o.lr_mult or o.wd_mult:
+            return False
+        if any(len(p._data or {}) != 1 or p.lr_mult != 1.0 or p.wd_mult != 1.0
+               for p in self._params if p.grad_req != "null"):
+            return False
+        from ..parallel.spmd import _RULES
+
+        hyper = {k: getattr(o, k) for k in self._FUSABLE[name]
+                 if hasattr(o, k)}
+        hyper["wd"] = o.wd
+        rule_init, rule_update = _RULES[name](hyper)
+
+        active = [p for p in self._params if p.grad_req != "null"
+                  and p._data is not None]
+        handles = [p.data() for p in active]
+        grads = [p.data().grad for p in active]
+        states = [rule_init(h.data) for h in handles]
+
+        @jax.jit
+        def fused(ws, gs, sts, lr, rescale):
+            new_ws, new_sts = [], []
+            for w, g, s in zip(ws, gs, sts):
+                w2, s2 = rule_update(
+                    w, g.astype(w.dtype) * rescale.astype(w.dtype), s,
+                    lr.astype(w.dtype))
+                new_ws.append(w2)
+                new_sts.append(s2)
+            return new_ws, new_sts
+
+        self._fused = (fused, handles, grads, states, active)
+        return self._fused
+
+    def _maybe_fused_update(self):
+        f = self._fused_setup()
+        if not f:
+            return False
+        fused, handles, grads, states, active = f
+        lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
+        rescale = jnp.asarray(self._optimizer.rescale_grad, jnp.float32)
+        new_ws, new_sts = fused([h.data for h in handles],
+                                [g.data for g in grads], states, lr, rescale)
+        for h, w in zip(handles, new_ws):
+            h._set_data(w)
+        self._fused = (fused, handles, grads, new_sts, active)
+        self._optimizer.num_update += 1
+        return True
+
     def _update(self, ignore_stale_grad=False):
+        if self._kvstore is None and self._maybe_fused_update():
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

@@ -64,7 +64,7 @@ class KVStoreLocal(KVStoreBase):
         k = self._key(key)
         if k not in self._store:
             raise MXNetError(f"key {key} has not been initialized")
-        merged = self._merge(value)
+        merged = self._compress(k, self._merge(value))
         if self._updater is not None:
             self._updater(int(key) if k.isdigit() else k, merged, self._store[k])
         elif self._optimizer is not None:
@@ -138,7 +138,32 @@ class KVStoreLocal(KVStoreBase):
         self._optimizer = optimizer
 
     def set_gradient_compression(self, compression_params):
-        self._compression = compression_params  # applied in dist store
+        """2-bit gradient compression (reference:
+        ``kv.set_gradient_compression`` -> ``gradient_compression.cc``).
+        Applied on the push path with per-key residuals."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            from ..base import MXNetError
+
+            raise MXNetError(f"unsupported compression type {ctype}")
+        self._compression = {
+            "threshold": float(compression_params.get("threshold", 0.5))
+        }
+        self._residuals = {}
+
+    def _compress(self, key, merged):
+        if getattr(self, "_compression", None) is None:
+            return merged
+        import jax.numpy as jnp
+
+        thr = self._compression["threshold"]
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros(merged.shape, merged.data.dtype)
+        acc = merged.data + res
+        q = jnp.where(acc >= thr, thr, jnp.where(acc <= -thr, -thr, 0.0))
+        self._residuals[key] = acc - q
+        return NDArray(q, ctx=merged.ctx)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         import pickle

@@ -263,3 +263,38 @@ def test_cast():
     net.initialize()
     net.cast("float16")
     assert net.weight.data().dtype == np.float16
+
+
+def test_trainer_fused_matches_eager():
+    """The fused multi-tensor update path must match per-param updates."""
+
+    def train(fused_allowed):
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(3, in_units=16))
+        net.initialize(init=mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9,
+                            "wd": 1e-4}, kvstore=None)
+        if not fused_allowed:
+            tr._fused = False
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        X = mx.nd.array(np.random.RandomState(1).randn(16, 8)
+                        .astype(np.float32))
+        Y = mx.nd.array(np.random.RandomState(2).randint(0, 3, (16,))
+                        .astype(np.float32))
+        for _ in range(5):
+            with autograd.record():
+                l = loss_fn(net(X), Y)
+            l.backward()
+            tr.step(16)
+        return [v.data().asnumpy()
+                for _, v in sorted(net.collect_params().items())], tr
+
+    wf, trf = train(True)
+    we, _ = train(False)
+    assert trf._fused not in (False, None), "fused path did not engage"
+    for a, b in zip(wf, we):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
