@@ -90,10 +90,14 @@ class Context:
     @property
     def jax_device(self) -> jax.Device:
         plat = _accelerator_platform()
+        # device ids index PROCESS-LOCAL devices: under multi-process SPMD
+        # (jax.distributed), jax.devices() spans all hosts and remote
+        # entries are non-addressable from this process.
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
-            devs = jax.devices("cpu") if plat != "cpu" else jax.devices()
+            devs = (jax.local_devices(backend="cpu") if plat != "cpu"
+                    else jax.local_devices())
         else:  # gpu / tpu -> default accelerator backend
-            devs = jax.devices()
+            devs = jax.local_devices()
         if self.device_id >= len(devs):
             raise MXNetError(
                 f"{self} out of range: backend '{plat}' has {len(devs)} device(s)"
@@ -144,7 +148,7 @@ def gpu(device_id: int = 0) -> Context:
 def num_gpus() -> int:
     """Number of accelerator devices (reference: ``context.py:num_gpus``)."""
     plat = _accelerator_platform()
-    return 0 if plat == "cpu" else len(jax.devices())
+    return 0 if plat == "cpu" else len(jax.local_devices())
 
 
 def num_tpus() -> int:

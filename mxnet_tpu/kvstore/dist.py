@@ -5,12 +5,19 @@ Reference being replaced: ``src/kvstore/kvstore_dist.h`` +
 SURVEY.md §3.5). TPU-native design: there are NO server processes. Every
 worker is a JAX process in one SPMD world (bootstrapped by
 ``jax.distributed.initialize`` — the PJRT coordination service replaces the
-ps-lite scheduler). ``pushpull`` lowers to a global-mesh ``psum`` riding
+ps-lite scheduler). ``pushpull`` lowers to a global-mesh all-reduce riding
 ICI within a slice and DCN across slices; ``rank``/``num_workers`` map to
 ``jax.process_index``/``process_count``.
+
+The reduction places each process's gradient as one shard of a global
+array along a ``dp`` axis (one device per process) and jit-sums over that
+axis with a replicated out-sharding — XLA lowers this to a single
+wire-speed AllReduce, unlike the round-1 allgather+host-sum fallback.
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as _np
 
@@ -21,16 +28,53 @@ from ..ndarray.ndarray import NDArray
 from .base import register_kvstore
 from .local import KVStoreLocal
 
+_REDUCE = {"mesh": None, "fn": None}
+
+
+def _reduce_mesh():
+    """Global mesh with ONE device per process, ordered by process index."""
+    if _REDUCE["mesh"] is None:
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, d)
+        ordered = [per_proc[i] for i in sorted(per_proc)]
+        from jax.sharding import Mesh
+
+        _REDUCE["mesh"] = Mesh(_np.array(ordered), ("dp",))
+    return _REDUCE["mesh"]
+
 
 def _global_allreduce(raw):
-    """Sum an array across all JAX processes (no-op single-process)."""
+    """Sum an array across all JAX processes (no-op single-process).
+
+    Lowered to one XLA AllReduce: the local array becomes this process's
+    shard of a (num_processes, ...) global array partitioned on ``dp``;
+    ``sum(axis=0)`` with a fully-replicated out-sharding is the reduce.
+    """
     if jax.process_count() == 1:
         return raw
-    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
-    # all-gather across processes then sum: rides ICI/DCN via XLA collectives
-    gathered = multihost_utils.process_allgather(raw)
-    return jnp.sum(gathered, axis=0)
+    mesh = _reduce_mesh()
+    n = jax.process_count()
+    my_dev = mesh.devices.flat[jax.process_index()]
+    raw = jnp.asarray(raw)
+    g = jax.make_array_from_single_device_arrays(
+        (n,) + raw.shape,
+        NamedSharding(mesh, P("dp")),
+        [jax.device_put(raw[None], my_dev)],
+    )
+    if _REDUCE["fn"] is None:
+        _REDUCE["fn"] = jax.jit(
+            lambda a: jnp.sum(a, axis=0),
+            out_shardings=NamedSharding(mesh, P()),
+        )
+    out = _REDUCE["fn"](g)
+    # the replicated output is locally addressable: take this process's
+    # on-device copy directly (no host round-trip) and re-commit it to a
+    # single-device array so downstream eager ops stay single-process
+    local = out.addressable_data(0)
+    return jax.device_put(local, jax.local_devices()[0])
 
 
 @register_kvstore("dist_tpu_sync")
@@ -49,24 +93,49 @@ class KVStoreDistTPU(KVStoreLocal):
     def num_workers(self):
         return jax.process_count()
 
-    def _merge(self, values):
-        local = super()._merge(values)
+    def init(self, key, value):
+        """Init with rank-0's value on every worker (reference: worker 0
+        pushes the init value to the servers; others pull it)."""
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
         if jax.process_count() > 1:
-            return NDArray(_global_allreduce(local.data), ctx=local.ctx)
-        return local
+            from jax.experimental import multihost_utils
+
+            synced = multihost_utils.broadcast_one_to_all(value.data)
+            value = NDArray(jnp.asarray(synced), ctx=value.ctx)
+        super().init(key, value)
+
+    def _reduce(self, key, merged):
+        if jax.process_count() > 1:
+            return NDArray(_global_allreduce(merged.data), ctx=merged.ctx)
+        return merged
 
     def barrier(self):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices(f"mxtpu_kv_barrier_{self._barrier_count}")
+            multihost_utils.sync_global_devices(
+                f"mxtpu_kv_barrier_{self._barrier_count}")
             self._barrier_count += 1
 
 
 def init_distributed(coordinator_address=None, num_processes=None, process_id=None,
                      **kwargs):
     """Bootstrap multi-host training (replaces ``tools/launch.py`` env setup:
-    DMLC_PS_ROOT_URI -> PJRT coordinator address)."""
+    DMLC_PS_ROOT_URI -> PJRT coordinator address).
+
+    Arguments default to the launcher's env contract (``MXTPU_COORDINATOR``,
+    ``MXTPU_NUM_PROCESSES``, ``MXTPU_PROCESS_ID``) so a worker script can
+    just call ``init_distributed()`` under ``tools/launch.py``.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXTPU_COORDINATOR")
+    if num_processes is None and "MXTPU_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["MXTPU_NUM_PROCESSES"])
+    if process_id is None and "MXTPU_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["MXTPU_PROCESS_ID"])
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

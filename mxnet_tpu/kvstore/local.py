@@ -64,7 +64,7 @@ class KVStoreLocal(KVStoreBase):
         k = self._key(key)
         if k not in self._store:
             raise MXNetError(f"key {key} has not been initialized")
-        merged = self._compress(k, self._merge(value))
+        merged = self._reduce(k, self._compress(k, self._merge(value)))
         if self._updater is not None:
             self._updater(int(key) if k.isdigit() else k, merged, self._store[k])
         elif self._optimizer is not None:
@@ -108,10 +108,11 @@ class KVStoreLocal(KVStoreBase):
             if out is not None:
                 self.pull(key, out=out, priority=priority)
             return
-        merged = self._merge(value)
         if out is None:
-            self.push(key, merged, priority)
+            self.push(key, value, priority)
         else:
+            k = self._key(key)
+            merged = self._reduce(k, self._compress(k, self._merge(value)))
             outs = out if isinstance(out, (list, tuple)) else [out]
             for o in outs:
                 o._set_data(
@@ -127,6 +128,13 @@ class KVStoreLocal(KVStoreBase):
         ids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
         for o, rid in zip(outs, ids):
             retain_rows(stored, rid, out=o)
+
+    def _reduce(self, key, merged):
+        """Cross-process reduction hook: identity in-process; the dist
+        store overrides this with the global-mesh psum. Runs after
+        ``_compress`` so compression happens before the wire, matching the
+        reference's worker-side compress-then-push order."""
+        return merged
 
     def set_updater(self, updater):
         self._updater = updater

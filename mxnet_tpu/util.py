@@ -49,7 +49,7 @@ def get_gpu_memory(dev_id=0):
     import jax
 
     try:
-        stats = jax.devices()[dev_id].memory_stats()
+        stats = jax.local_devices()[dev_id].memory_stats()
         return (stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0))
     except Exception:
         return (0, 0)

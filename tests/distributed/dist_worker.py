@@ -1,0 +1,105 @@
+"""Multi-process dist_tpu_sync worker (reference analog:
+``tests/nightly/dist_sync_kvstore.py`` run under ``tools/launch.py``).
+
+Spawned by ``tests/distributed/test_dist_tpu_sync.py`` via ``tools/launch.py -n N``.
+Each rank runs the same assertions against analytically-known aggregates;
+any assertion failure exits nonzero and fails the launching pytest.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore.dist import init_distributed
+
+init_distributed()  # picks up the MXTPU_* env contract from tools/launch.py
+
+rank = int(os.environ["MXTPU_PROCESS_ID"])
+nworkers = int(os.environ["MXTPU_NUM_PROCESSES"])
+assert jax.process_count() == nworkers, (jax.process_count(), nworkers)
+assert jax.process_index() == rank
+
+kv = mx.kv.create("dist_tpu_sync")
+assert kv.rank == rank and kv.num_workers == nworkers
+
+SHAPE = (4, 5)
+
+
+def full(v):
+    return mx.nd.array(np.full(SHAPE, v, np.float32))
+
+
+# 1) init consistency: ranks propose different values; rank 0's must win
+kv.init("w", full(7.0 + rank))
+out = mx.nd.zeros(SHAPE)
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, 7.0, np.float32))
+kv.barrier()
+
+# 2) push -> cross-process sum visible on every rank
+kv.push("w", full(rank + 1.0))
+kv.pull("w", out=out)
+expect = nworkers * (nworkers + 1) / 2.0
+np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, expect, np.float32))
+kv.barrier()
+
+# 3) pushpull(out=...) — Trainer allreduce path; store stays untouched
+grad = full(2.0 * (rank + 1))
+kv.pushpull("w", grad, out=grad)
+np.testing.assert_allclose(grad.asnumpy(),
+                           np.full(SHAPE, 2.0 * expect, np.float32))
+kv.pull("w", out=out)
+np.testing.assert_allclose(out.asnumpy(), np.full(SHAPE, expect, np.float32))
+kv.barrier()
+
+# 4) updater runs on the globally-summed gradient, identically on all ranks
+kv2 = mx.kv.create("dist_tpu_sync")
+kv2.init("u", full(1.0))
+
+
+def updater(key, grad, weight):
+    weight -= 0.1 * grad
+
+
+kv2.set_updater(updater)
+kv2.push("u", full(1.0))  # global grad = nworkers
+kv2.pull("u", out=out)
+np.testing.assert_allclose(
+    out.asnumpy(), np.full(SHAPE, 1.0 - 0.1 * nworkers, np.float32), rtol=1e-6)
+kv2.barrier()
+
+# 5) row_sparse_pull after a distributed push
+kv.init("emb", mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3)))
+emb_out = mx.nd.zeros((4, 3))
+kv.row_sparse_pull("emb", out=emb_out, row_ids=mx.nd.array([1, 3]))
+expected = np.zeros((4, 3), np.float32)
+expected[[1, 3]] = np.arange(12, dtype=np.float32).reshape(4, 3)[[1, 3]]
+np.testing.assert_allclose(emb_out.asnumpy(), expected)
+kv.barrier()
+
+# 6) 2-bit gradient compression applied BEFORE the wire, with residuals
+kv3 = mx.kv.create("dist_tpu_sync")
+kv3.init("c", full(0.0))
+kv3.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+kv3.push("c", full(1.0))  # each rank quantizes 1.0 -> 0.5, residual 0.5
+kv3.pull("c", out=out)
+np.testing.assert_allclose(out.asnumpy(),
+                           np.full(SHAPE, 0.5 * nworkers, np.float32))
+kv3.push("c", full(0.25))  # residual 0.5 + 0.25 >= thr -> 0.5 again
+kv3.pull("c", out=out)
+np.testing.assert_allclose(out.asnumpy(),
+                           np.full(SHAPE, 0.5 * nworkers, np.float32))
+kv3.barrier()
+
+print(f"DIST_WORKER_OK rank={rank}/{nworkers}", flush=True)
