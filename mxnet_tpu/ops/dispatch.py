@@ -16,8 +16,17 @@ from __future__ import annotations
 
 import jax
 
-from .. import autograd
+from .. import autograd, engine
 from .registry import OpDef, jitted
+
+
+def _maybe_sync(res):
+    """NaiveEngine analog (SURVEY §5.2): with MXTPU_SYNC_EXEC=1, block
+    until the dispatched computation finishes so errors surface at the
+    faulting op instead of at the next sync point."""
+    if engine.sync_exec_enabled():
+        jax.block_until_ready(res)
+    return res
 
 
 def _unwrap(x):
@@ -46,7 +55,7 @@ def apply_op(opdef: OpDef, args, kwargs, out=None):
         if tracked_idx:
             return _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out)
 
-    res = jitted(opdef, kwargs)(*raw)
+    res = _maybe_sync(jitted(opdef, kwargs)(*raw))
     return _wrap_result(res, ctx, out)
 
 
@@ -63,6 +72,7 @@ def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
         return fn(*full)
 
     res, vjp_fn = jax.vjp(f, *tracked_raw)
+    _maybe_sync(res)
     result = _wrap_result(res, ctx, out)
     outs = result if isinstance(result, (list, tuple)) else [result]
 

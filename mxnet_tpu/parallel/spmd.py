@@ -102,7 +102,25 @@ def _lamb_rule(hyper):
     return init, update
 
 
-_RULES = {"sgd": _sgd_rule, "nag": _sgd_rule, "adam": _adam_rule,
+def _nag_rule(hyper):
+    """Nesterov momentum, matching ``optimizer.NAG.update``."""
+    mom = hyper.get("momentum", 0.0)
+    wd = hyper.get("wd", 0.0)
+
+    def init(w):
+        return (jnp.zeros_like(w),) if mom else ()
+
+    def update(w, g, state, lr):
+        g = g + wd * w
+        if mom:
+            m = mom * state[0] + g
+            return w - lr * (g + mom * m), (m,)
+        return w - lr * g, ()
+
+    return init, update
+
+
+_RULES = {"sgd": _sgd_rule, "nag": _nag_rule, "adam": _adam_rule,
           "adamw": _adam_rule, "lamb": _lamb_rule}
 
 
@@ -148,17 +166,50 @@ class SPMDTrainStep:
         spec = self._param_sharding.get(name, P())
         return NamedSharding(self.mesh, spec)
 
+    def _opt_state_spec(self, name, raw):
+        """ZeRO-1 (SURVEY P13): moment tensors shard along dim 0 over the
+        data axis, unless the param itself is already sharded on dim 0
+        (tensor parallel) or dim 0 doesn't divide, in which case they
+        follow the param's sharding."""
+        pspec = self._param_sharding.get(name, P())
+        if not self._shard_opt_states or self.mesh is None:
+            return pspec
+        dp = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(
+            self.batch_axis)
+        if (dp and raw.ndim >= 1 and raw.shape[0] % dp == 0
+                and not (len(pspec) > 0 and pspec[0] is not None)):
+            return P(self.batch_axis, *([None] * (raw.ndim - 1)))
+        return pspec
+
     def init_state(self):
         names, handles, diff = self._collect()
         self._names, self._handles, self._diff = names, handles, diff
         params = []
         opt_states = []
+        opt_specs = []
         for n, h, d in zip(names, handles, diff):
             raw = h.data
             if self.mesh is not None:
                 raw = jax.device_put(raw, self._sharding_for(n, raw))
             params.append(raw)
-            opt_states.append(self._rule_init(raw) if d else ())
+            if not d:
+                opt_states.append(())
+                opt_specs.append(())
+                continue
+            state = self._rule_init(raw)
+            spec = self._opt_state_spec(n, raw)
+            # only moment-shaped leaves get the ZeRO spec; scalars (step
+            # counters) stay replicated
+            leaf_specs = tuple(
+                spec if getattr(leaf, "shape", ()) == raw.shape else P()
+                for leaf in state)
+            if self.mesh is not None:
+                state = tuple(
+                    jax.device_put(leaf, NamedSharding(self.mesh, sp))
+                    for leaf, sp in zip(state, leaf_specs))
+            opt_states.append(state)
+            opt_specs.append(leaf_specs)
+        self._opt_specs = opt_specs
         self._state = (params, opt_states)
 
     # -- compiled step ----------------------------------------------------
@@ -188,6 +239,9 @@ class SPMDTrainStep:
                 _random.pop_trace_key()
                 _TRACE_STATE.active = False
 
+        mesh = self.mesh
+        opt_specs = getattr(self, "_opt_specs", None)
+
         def step(params, opt_states, x, y, lr, key):
             diff_idx = [i for i, d in enumerate(diff) if d]
 
@@ -205,6 +259,13 @@ class SPMDTrainStep:
             new_states = list(opt_states)
             for k, i in enumerate(diff_idx):
                 w, s = rule_update(params[i], grads[k], opt_states[i], lr)
+                if mesh is not None and opt_specs is not None and opt_specs[i]:
+                    # pin ZeRO-1 shardings so XLA keeps moments sharded
+                    # across steps instead of replicating them
+                    s = tuple(
+                        jax.lax.with_sharding_constraint(
+                            leaf, NamedSharding(mesh, sp))
+                        for leaf, sp in zip(s, opt_specs[i]))
                 new_params[i] = w
                 new_states[i] = s
             return new_params, new_states, loss
