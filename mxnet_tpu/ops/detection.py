@@ -1,0 +1,272 @@
+"""Detection operators: SSD multibox pipeline + Faster-RCNN proposals.
+
+Reference surface: ``src/operator/contrib/multibox_target.cc``,
+``multibox_detection.cc``, ``proposal.cc`` (+ ``multibox_prior.cc``, which
+lives in ``ops/contrib.py``).
+
+TPU-first notes: everything is static-shape. Matching is a dense IoU
+matrix + argmax (the reference ran a greedy CPU bipartite loop); NMS is a
+fixed-trip-count ``fori_loop`` over score-sorted boxes producing a padded
+(-1 filled) result, so the whole detection head stays inside one XLA
+program — no host sync, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _corner_to_center(boxes):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + w / 2
+    cy = boxes[..., 1] + h / 2
+    return cx, cy, w, h
+
+
+def _iou_matrix(a, b):
+    """a (N,4), b (M,4) corners -> (N,M) IoU."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0.0) * jnp.maximum(a[:, 3] - a[:, 1], 0.0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0.0) * jnp.maximum(b[:, 3] - b[:, 1], 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register("MultiBoxTarget", aliases=("_contrib_MultiBoxTarget", "multibox_target"))
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5, minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference: multibox_target.cc).
+
+    anchor (1, N, 4) corners; label (B, M, 5) rows [cls, x1, y1, x2, y2]
+    (-1 padded); cls_pred (B, C+1, N) for negative mining.
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)).
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    v = jnp.asarray(variances, anchors.dtype)
+
+    def one_batch(lab, cpred):
+        valid = lab[:, 0] >= 0
+        gt = lab[:, 1:5]
+        iou = _iou_matrix(anchors, gt)  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+
+        best_gt = jnp.argmax(iou, axis=1)  # per anchor
+        best_gt_iou = jnp.max(iou, axis=1)
+        matched = best_gt_iou > overlap_threshold
+
+        # force-match the best anchor of each valid gt; invalid (padded)
+        # gts are routed to out-of-range index n so mode="drop" discards
+        # them instead of clobbering a real gt's slot at anchor 0
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        best_anchor = jnp.where(valid, best_anchor, n)
+        forced = jnp.zeros((n,), bool)
+        forced = forced.at[best_anchor].set(True, mode="drop")
+        forced_gt = jnp.zeros((n,), jnp.int32)
+        forced_gt = forced_gt.at[best_anchor].set(
+            jnp.arange(gt.shape[0], dtype=jnp.int32), mode="drop")
+        match_gt = jnp.where(forced, forced_gt, best_gt.astype(jnp.int32))
+        is_pos = matched | forced
+
+        cls = lab[match_gt, 0] + 1.0
+        cls_target = jnp.where(is_pos, cls, 0.0)
+
+        if negative_mining_ratio > 0:
+            # rank negatives by max non-background confidence; keep the
+            # hardest ratio*num_pos, set the rest to ignore_label
+            probs = jax.nn.softmax(cpred, axis=0)
+            max_fg = jnp.max(probs[1:], axis=0)  # (N,)
+            neg = (~is_pos) & (max_fg > negative_mining_thresh)
+            num_pos = jnp.sum(is_pos)
+            budget = jnp.maximum(
+                (negative_mining_ratio * num_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            order = jnp.argsort(jnp.where(neg, -max_fg, jnp.inf))
+            rank = jnp.zeros((n,), jnp.int32).at[order].set(
+                jnp.arange(n, dtype=jnp.int32))
+            keep_neg = neg & (rank < budget)
+            cls_target = jnp.where(is_pos, cls_target,
+                                   jnp.where(keep_neg, 0.0, ignore_label))
+
+        # encode matched boxes (center form, variance-scaled)
+        acx, acy, aw, ah = _corner_to_center(anchors)
+        g = gt[match_gt]
+        gcx, gcy, gw, gh = _corner_to_center(g)
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / v[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / v[1]
+        tw = jnp.log(jnp.maximum(gw, 1e-8) / jnp.maximum(aw, 1e-8)) / v[2]
+        th = jnp.log(jnp.maximum(gh, 1e-8) / jnp.maximum(ah, 1e-8)) / v[3]
+        target = jnp.stack([tx, ty, tw, th], axis=-1)
+        mask = is_pos.astype(anchors.dtype)[:, None]
+        return (target * mask).reshape(-1), jnp.broadcast_to(
+            mask, (n, 4)).reshape(-1), cls_target
+
+    bt, bm, ct = jax.vmap(one_batch)(label, cls_pred)
+    return bt, bm, ct
+
+
+def _decode_boxes(anchors, loc, variances, clip):
+    acx, acy, aw, ah = _corner_to_center(anchors)
+    v = variances
+    cx = loc[..., 0] * v[0] * aw + acx
+    cy = loc[..., 1] * v[1] * ah + acy
+    w = jnp.exp(jnp.clip(loc[..., 2] * v[2], -10, 10)) * aw
+    h = jnp.exp(jnp.clip(loc[..., 3] * v[3], -10, 10)) * ah
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+def _nms_loop(boxes, scores, classes, iou_threshold, force_suppress):
+    """Greedy NMS on score-sorted boxes; returns keep mask (same order)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    c = classes[order]
+    s = scores[order]
+    iou = _iou_matrix(b, b)
+    same_cls = (c[:, None] == c[None, :]) | force_suppress
+    suppress = (iou > iou_threshold) & same_cls
+
+    def body(i, keep):
+        # i suppresses later boxes only if i itself is kept and valid
+        row = suppress[i] & (jnp.arange(n) > i) & keep[i] & (s[i] > -jnp.inf)
+        return keep & ~row
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return keep_sorted[inv], order
+
+
+@register("MultiBoxDetection",
+          aliases=("_contrib_MultiBoxDetection", "multibox_detection"))
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                       background_id=0, nms_threshold=0.5,
+                       force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference head (reference: multibox_detection.cc).
+
+    cls_prob (B, C, N), loc_pred (B, N*4), anchor (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, x1, y1, x2, y2], -1 padded.
+    """
+    anchors = anchor.reshape(-1, 4)
+    n = anchors.shape[0]
+    v = jnp.asarray(variances, anchors.dtype)
+
+    def one_batch(cp, lp):
+        loc = lp.reshape(n, 4)
+        boxes = _decode_boxes(anchors, loc, v, clip)
+        fg = jnp.concatenate([cp[:background_id], cp[background_id + 1:]],
+                             axis=0) if cp.shape[0] > 1 else cp
+        # fg row index IS the output class id (reference convention:
+        # detection ids are 0-based with background removed)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        valid = score > threshold
+        score_v = jnp.where(valid, score, -jnp.inf)
+        keep, order = _nms_loop(boxes, score_v, cls_id, nms_threshold,
+                                force_suppress)
+        ok = valid & keep
+        rows = jnp.concatenate([
+            jnp.where(ok, cls_id, -1.0)[:, None],
+            jnp.where(ok, score, -1.0)[:, None],
+            jnp.where(ok[:, None], boxes, -1.0),
+        ], axis=1)
+        # reference returns rows sorted by score with invalid (-1) rows mixed
+        # at their original positions after nms_topk; we sort for stability
+        out = rows[order]
+        if nms_topk > 0:
+            mask = (jnp.arange(n) < nms_topk)[:, None]
+            out = jnp.where(mask, out, -1.0)
+        return out
+
+    return jax.vmap(one_batch)(cls_prob, loc_pred)
+
+
+def _make_grid_anchors(h, w, stride, scales, ratios, dtype):
+    # scales/ratios are static attrs (python tuples), not traced values
+    base = stride
+    ws = []
+    for r in ratios:
+        for s in scales:
+            size = base * float(s)
+            ws.append((size * (1.0 / float(r)) ** 0.5,
+                       size * float(r) ** 0.5))
+    wh = jnp.asarray(ws, dtype)  # (A, 2)
+    cx = (jnp.arange(w, dtype=dtype) + 0.5) * stride
+    cy = (jnp.arange(h, dtype=dtype) + 0.5) * stride
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([gx, gy], axis=-1).reshape(-1, 1, 2)  # (HW, 1, 2)
+    half = wh[None] / 2.0  # (1, A, 2)
+    boxes = jnp.concatenate([centers - half, centers + half], axis=-1)
+    return boxes.reshape(-1, 4)  # (HW*A, 4)
+
+
+@register("Proposal", aliases=("_contrib_Proposal", "proposal"))
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):
+    """Faster-RCNN proposal layer (reference: contrib/proposal.cc).
+
+    cls_prob (B, 2A, H, W), bbox_pred (B, 4A, H, W), im_info (B, 3)
+    [height, width, scale]. Returns (B*post_nms, 5) rows
+    [batch_idx, x1, y1, x2, y2] (and scores if output_score).
+    """
+    b, c2a, h, w = cls_prob.shape
+    a = c2a // 2
+    dtype = cls_prob.dtype
+    anchors = _make_grid_anchors(h, w, feature_stride, scales, ratios, dtype)
+    n = anchors.shape[0]
+    pre = min(rpn_pre_nms_top_n, n)
+
+    def one_batch(cp, bp, info):
+        scores = cp[a:].transpose(1, 2, 0).reshape(-1)  # fg scores (HW*A,)
+        deltas = bp.reshape(a, 4, h, w).transpose(2, 3, 0, 1).reshape(-1, 4)
+        acx, acy, aw, ah = _corner_to_center(anchors)
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * aw
+        ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - pw / 2, cy - ph / 2,
+                           cx + pw / 2, cy + ph / 2], axis=-1)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, info[1] - 1),
+            jnp.clip(boxes[:, 1], 0, info[0] - 1),
+            jnp.clip(boxes[:, 2], 0, info[1] - 1),
+            jnp.clip(boxes[:, 3], 0, info[0] - 1)], axis=-1)
+        min_size = rpn_min_size * info[2]
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + 1) >= min_size) & \
+            ((boxes[:, 3] - boxes[:, 1] + 1) >= min_size)
+        scores = jnp.where(keep_size, scores, -jnp.inf)
+        top_scores, top_idx = lax.top_k(scores, pre)
+        top_boxes = boxes[top_idx]
+        keep, order = _nms_loop(top_boxes, top_scores,
+                                jnp.zeros((pre,), dtype), threshold, True)
+        kept_scores = jnp.where(keep, top_scores, -jnp.inf)
+        sel_scores, sel = lax.top_k(kept_scores, rpn_post_nms_top_n)
+        out_boxes = top_boxes[sel]
+        # pad slots with no surviving proposal by repeating the best box
+        # (reference pads with index-0 samples), keeping shapes static
+        ok = sel_scores > -jnp.inf
+        out_boxes = jnp.where(ok[:, None], out_boxes, out_boxes[0])
+        return out_boxes, jnp.where(ok, sel_scores, 0.0)
+
+    boxes, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(b, dtype=dtype), rpn_post_nms_top_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

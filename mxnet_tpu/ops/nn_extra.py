@@ -1,0 +1,432 @@
+"""Vision / sampling / legacy-loss operators beyond the round-1 core.
+
+Reference surface: ``src/operator/`` ``upsampling.cc``, ``roi_pooling.cc``,
+``grid_generator.cc``, ``bilinear_sampler.cc``, ``spatial_transformer.cc``,
+``svm_output.cc``, ``regression_output.cc``, ``correlation.cc``,
+``src/operator/contrib/deformable_convolution.cc``, ``nn/im2col.h``.
+
+TPU-first notes: DeformableConvolution is expressed as bilinear gathers +
+one big matmul (MXU-friendly) instead of the reference's per-pixel CUDA
+kernel; Correlation unrolls the static displacement grid into fused
+elementwise-reduce ops; im2col/col2im use XLA's conv patch-extraction and
+its transpose (via vjp) rather than hand-written scatter loops.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# --------------------------------------------------------------------------
+# UpSampling / ROIPooling
+# --------------------------------------------------------------------------
+
+
+@register("UpSampling", aliases=("upsampling",))
+def upsampling(*arrays, scale=2, sample_type="nearest", num_filter=0,
+               multi_input_mode="concat", num_args=1, workspace=512):
+    """NCHW upsampling. nearest repeats pixels; bilinear resizes (the
+    reference used a fixed bilinear-kernel deconvolution)."""
+    outs = []
+    for data in arrays:
+        n, c, h, w = data.shape
+        if sample_type == "nearest":
+            out = jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
+        else:
+            out = jax.image.resize(data, (n, c, h * scale, w * scale),
+                                   method="linear")
+        outs.append(out)
+    if len(outs) == 1:
+        return outs[0]
+    if multi_input_mode == "sum":
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = acc + o
+        return acc
+    return jnp.concatenate(outs, axis=1)
+
+
+@register("ROIPooling", aliases=("roi_pooling",))
+def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max-pool each ROI into a fixed grid (reference: roi_pooling.cc).
+    rois: (R, 5) rows [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = pooled_size
+    n, c, h, w = data.shape
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * spatial_scale)
+        y1 = jnp.round(roi[2] * spatial_scale)
+        x2 = jnp.round(roi[3] * spatial_scale)
+        y2 = jnp.round(roi[4] * spatial_scale)
+        rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        img = data[b]  # (C, H, W)
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+
+        def pool_bin(py, px):
+            ys_lo = jnp.floor(y1 + py * bin_h)
+            ys_hi = jnp.ceil(y1 + (py + 1) * bin_h)
+            xs_lo = jnp.floor(x1 + px * bin_w)
+            xs_hi = jnp.ceil(x1 + (px + 1) * bin_w)
+            m = ((ys[:, None] >= ys_lo) & (ys[:, None] < ys_hi)
+                 & (xs[None, :] >= xs_lo) & (xs[None, :] < xs_hi))
+            neg = jnp.finfo(data.dtype).min
+            masked = jnp.where(m[None], img, neg)
+            val = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.any(m), val, 0.0)
+
+        grid = jnp.stack([jnp.stack([pool_bin(py, px)
+                                     for px in range(pw)], axis=-1)
+                          for py in range(ph)], axis=-2)
+        return grid  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+# --------------------------------------------------------------------------
+# GridGenerator / BilinearSampler / SpatialTransformer
+# --------------------------------------------------------------------------
+
+
+def _affine_grid(theta, target_shape):
+    """theta (N, 6) -> sampling grid (N, 2, H, W), coords in [-1, 1]."""
+    hh, ww = target_shape
+    ys = jnp.linspace(-1.0, 1.0, hh)
+    xs = jnp.linspace(-1.0, 1.0, ww)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()], axis=0)  # (3, HW)
+    th = theta.reshape(-1, 2, 3)
+    out = jnp.einsum("nij,jk->nik", th, base)  # (N, 2, HW)
+    return out.reshape(-1, 2, hh, ww)
+
+
+@register("GridGenerator", aliases=("grid_generator",))
+def grid_generator(data, transform_type="affine", target_shape=(0, 0)):
+    if transform_type == "affine":
+        return _affine_grid(data, tuple(target_shape))
+    # 'warp': data is (N, 2, H, W) flow field in pixels; normalize to [-1,1]
+    n, _, h, w = data.shape
+    ys = jnp.arange(h, dtype=data.dtype)
+    xs = jnp.arange(w, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    fx = data[:, 0] + gx
+    fy = data[:, 1] + gy
+    nx = 2.0 * fx / jnp.maximum(w - 1, 1) - 1.0
+    ny = 2.0 * fy / jnp.maximum(h - 1, 1) - 1.0
+    return jnp.stack([nx, ny], axis=1)
+
+
+def _bilinear_sample_one(img, grid):
+    """img (C, H, W), grid (2, HO, WO) normalized [-1,1] -> (C, HO, WO).
+    Out-of-boundary reads return 0 (reference boundary behavior)."""
+    c, h, w = img.shape
+    gx = (grid[0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def read(yi, xi):
+        inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = img[:, yc, xc]  # (C, HO, WO)
+        return jnp.where(inside[None], v, 0.0)
+
+    v00 = read(y0, x0)
+    v01 = read(y0, x0 + 1)
+    v10 = read(y0 + 1, x0)
+    v11 = read(y0 + 1, x0 + 1)
+    return ((1 - wy) * (1 - wx))[None] * v00 + ((1 - wy) * wx)[None] * v01 \
+        + (wy * (1 - wx))[None] * v10 + (wy * wx)[None] * v11
+
+
+@register("BilinearSampler", aliases=("bilinear_sampler",))
+def bilinear_sampler(data, grid, cudnn_off=False):
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+@register("SpatialTransformer", aliases=("spatial_transformer",))
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=False):
+    grid = _affine_grid(loc, tuple(target_shape))
+    return jax.vmap(_bilinear_sample_one)(data, grid)
+
+
+# --------------------------------------------------------------------------
+# im2col / col2im
+# --------------------------------------------------------------------------
+
+
+def _im2col_raw(data, kernel, stride, dilate, pad):
+    patches = lax.conv_general_dilated_patches(
+        data,
+        filter_shape=tuple(kernel),
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (N, C*kh*kw, OH, OW)
+    n, ckk, oh, ow = patches.shape
+    return patches.reshape(n, ckk, oh * ow)
+
+
+@register("im2col", aliases=("_npx_im2col",))
+def im2col(data, kernel=(3, 3), stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    return _im2col_raw(data, tuple(kernel), tuple(stride), tuple(dilate),
+                       tuple(pad))
+
+
+@register("col2im", aliases=("_npx_col2im",))
+def col2im(data, input_size=None, kernel=(3, 3), stride=(1, 1),
+           dilate=(1, 1), pad=(0, 0)):
+    """Scatter-add columns back to the image: exactly the transpose of
+    im2col, obtained from XLA as the vjp of patch extraction."""
+    n = data.shape[0]
+    c = int(input_size[0])
+    shape = (n, c, int(input_size[1]), int(input_size[2]))
+    zero = jnp.zeros(shape, data.dtype)
+    _, vjp = jax.vjp(lambda x: _im2col_raw(x, tuple(kernel), tuple(stride),
+                                           tuple(dilate), tuple(pad)), zero)
+    return vjp(data)[0]
+
+
+# --------------------------------------------------------------------------
+# DeformableConvolution (contrib)
+# --------------------------------------------------------------------------
+
+
+@register("DeformableConvolution", aliases=("_contrib_DeformableConvolution",))
+def deformable_convolution(data, offset, weight, *maybe_bias, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=0, num_group=1, num_deformable_group=1,
+                           no_bias=False, workspace=1024, layout=None):
+    """Deformable conv v1 (reference: contrib/deformable_convolution.cc).
+
+    TPU-first: bilinear-gather the deformed sampling points for every
+    kernel tap into an im2col-style matrix, then one (C*kh*kw) x OHW
+    matmul per image rides the MXU.
+    """
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    n, c, h, w = data.shape
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    dg = num_deformable_group
+    cg = c // dg
+
+    # base sampling positions per output pixel and kernel tap
+    oy = jnp.arange(oh) * sh - ph
+    ox = jnp.arange(ow) * sw - pw
+    ky = jnp.arange(kh) * dh
+    kx = jnp.arange(kw) * dw
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]  # (OH,1,KH,1)
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]  # (1,OW,1,KW)
+    base_y = jnp.broadcast_to(base_y, (oh, ow, kh, kw)).astype(data.dtype)
+    base_x = jnp.broadcast_to(base_x, (oh, ow, kh, kw)).astype(data.dtype)
+
+    # offset: (N, dg*2*kh*kw, OH, OW) ordered (y, x) per tap
+    off = offset.reshape(n, dg, kh, kw, 2, oh, ow)
+    off_y = off[:, :, :, :, 0].transpose(0, 1, 4, 5, 2, 3)  # (N,dg,OH,OW,KH,KW)
+    off_x = off[:, :, :, :, 1].transpose(0, 1, 4, 5, 2, 3)
+
+    sy = base_y[None, None] + off_y  # (N, dg, OH, OW, KH, KW)
+    sx = base_x[None, None] + off_x
+
+    def sample_image(img, syi, sxi):
+        # img (dg, cg, H, W); syi/sxi (dg, OH, OW, KH, KW)
+        def per_group(gimg, gy, gx):
+            y0 = jnp.floor(gy)
+            x0 = jnp.floor(gx)
+            wy = gy - y0
+            wx = gx - x0
+
+            def read(yi, xi):
+                inside = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+                yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                v = gimg[:, yc, xc]  # (cg, OH, OW, KH, KW)
+                return jnp.where(inside[None], v, 0.0)
+
+            v = ((1 - wy) * (1 - wx))[None] * read(y0, x0) \
+                + ((1 - wy) * wx)[None] * read(y0, x0 + 1) \
+                + (wy * (1 - wx))[None] * read(y0 + 1, x0) \
+                + (wy * wx)[None] * read(y0 + 1, x0 + 1)
+            return v  # (cg, OH, OW, KH, KW)
+
+        return jax.vmap(per_group)(img, syi, sxi)  # (dg, cg, OH, OW, KH, KW)
+
+    cols = jax.vmap(sample_image)(data.reshape(n, dg, cg, h, w), sy, sx)
+    # -> (N, C, KH, KW, OH*OW) column matrix, then one matmul on the MXU
+    cols = cols.reshape(n, c, oh, ow, kh, kw).transpose(0, 1, 4, 5, 2, 3)
+    cols = cols.reshape(n, c * kh * kw, oh * ow)
+    wmat = weight.reshape(num_filter, c * kh * kw // num_group)
+    if num_group == 1:
+        out = jnp.einsum("fk,nkp->nfp", wmat, cols)
+    else:
+        cols_g = cols.reshape(n, num_group, (c // num_group) * kh * kw, -1)
+        wg = wmat.reshape(num_group, num_filter // num_group, -1)
+        out = jnp.einsum("gfk,ngkp->ngfp", wg, cols_g).reshape(
+            n, num_filter, oh * ow)
+    out = out.reshape(n, num_filter, oh, ow)
+    if maybe_bias and not no_bias:
+        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Correlation (optical flow)
+# --------------------------------------------------------------------------
+
+
+@register("Correlation", aliases=("correlation",))
+def correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
+                stride2=1, pad_size=4, is_multiply=True):
+    """Correlation layer (reference: correlation.cc / FlowNet). The static
+    displacement grid unrolls into shifted elementwise products that XLA
+    fuses; output channel d = one displacement."""
+    n, c, h, w = data1.shape
+    pad = pad_size
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    d = max_displacement // stride2
+    bound = max_displacement + kernel_size // 2
+    oh = (h + 2 * pad - 2 * bound) // stride1 or 1
+    ow = (w + 2 * pad - 2 * bound) // stride1 or 1
+    k = kernel_size
+    outs = []
+    ys = bound + jnp.arange(oh) * stride1
+    xs = bound + jnp.arange(ow) * stride1
+
+    def window(padded, cy_off, cx_off):
+        # gather k x k windows centered at (ys+cy_off, xs+cx_off)
+        acc = 0.0
+        for iy in range(-(k // 2), k // 2 + 1):
+            for ix in range(-(k // 2), k // 2 + 1):
+                rows = ys + cy_off + iy
+                cols = xs + cx_off + ix
+                acc = acc + padded[:, :, rows][:, :, :, cols]
+        return acc / (k * k)
+
+    w1 = window(p1, 0, 0)
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            w2 = window(p2, dy * stride2, dx * stride2)
+            if is_multiply:
+                corr = jnp.mean(w1 * w2, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(w1 - w2), axis=1)
+            outs.append(corr)
+    return jnp.stack(outs, axis=1)
+
+
+# --------------------------------------------------------------------------
+# legacy loss layers (Module era): forward = identity, custom gradient
+# --------------------------------------------------------------------------
+
+
+def _make_regression_output(grad_fn, opname, aliases):
+    @_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def _core(data, label, grad_scale):
+        return data
+
+    def _fwd(data, label, grad_scale):
+        return data, (data, label)
+
+    def _bwd(grad_scale, res, g):
+        data, label = res
+        # reference normalizes by per-sample output count (Size()/shape[0],
+        # regression_output-inl.h), NOT by batch size
+        d = max(int(data.size // data.shape[0]), 1) if data.ndim else 1
+        grad = grad_fn(data, label.reshape(data.shape)) * (grad_scale / d)
+        return grad, jnp.zeros_like(label)
+
+    _core.defvjp(_fwd, _bwd)
+
+    @register(opname, aliases=aliases)
+    def op(data, label, grad_scale=1.0):
+        return _core(data, label, grad_scale)
+
+    return op
+
+
+linear_regression_output = _make_regression_output(
+    lambda d, l: d - l, "LinearRegressionOutput",
+    ("linear_regression_output",))
+
+mae_regression_output = _make_regression_output(
+    lambda d, l: jnp.sign(d - l), "MAERegressionOutput",
+    ("mae_regression_output",))
+
+
+# LogisticRegressionOutput's forward is sigmoid(data), so it gets its own
+# custom-vjp core instead of the identity-forward factory above.
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _logistic_core(data, label, grad_scale):
+    return jax.nn.sigmoid(data)
+
+
+def _logistic_fwd(data, label, grad_scale):
+    return jax.nn.sigmoid(data), (data, label)
+
+
+def _logistic_bwd(grad_scale, res, g):
+    data, label = res
+    d = max(int(data.size // data.shape[0]), 1) if data.ndim else 1
+    grad = (jax.nn.sigmoid(data) - label.reshape(data.shape)) * (grad_scale / d)
+    return grad, jnp.zeros_like(label)
+
+
+_logistic_core.defvjp(_logistic_fwd, _logistic_bwd)
+
+
+@register("LogisticRegressionOutput", aliases=("logistic_regression_output",))
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _logistic_core(data, label, grad_scale)
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _svm_core(data, label, margin, regularization_coef, use_linear):
+    return data
+
+
+def _svm_fwd(data, label, margin, regularization_coef, use_linear):
+    return data, (data, label)
+
+
+def _svm_bwd(margin, regularization_coef, use_linear, res, g):
+    data, label = res
+    n, k = data.shape
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), k, dtype=data.dtype)
+    score_y = jnp.sum(data * onehot, axis=1, keepdims=True)
+    viol = margin - (score_y - data)  # margin violation per class
+    if use_linear:
+        mask = (viol > 0).astype(data.dtype) * (1.0 - onehot)
+        grad = mask - onehot * jnp.sum(mask, axis=1, keepdims=True)
+    else:  # squared hinge
+        mask = jnp.maximum(viol, 0.0) * (1.0 - onehot)
+        grad = 2.0 * mask - 2.0 * onehot * jnp.sum(mask, axis=1, keepdims=True)
+    return grad * regularization_coef, jnp.zeros_like(label)
+
+
+_svm_core.defvjp(_svm_fwd, _svm_bwd)
+
+
+@register("SVMOutput", aliases=("svm_output",))
+def svm_output(data, label, margin=1.0, regularization_coef=1.0,
+               use_linear=False):
+    return _svm_core(data, label, margin, regularization_coef, use_linear)

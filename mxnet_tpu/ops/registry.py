@@ -20,13 +20,14 @@ _OPS: dict[str, "OpDef"] = {}
 
 
 class OpDef:
-    __slots__ = ("fn", "name", "aliases", "wrap_out", "as_method")
+    __slots__ = ("fn", "name", "aliases", "wrap_out", "as_method", "jit")
 
-    def __init__(self, fn, name, aliases=(), as_method=None):
+    def __init__(self, fn, name, aliases=(), as_method=None, jit=True):
         self.fn = fn
         self.name = name
         self.aliases = aliases
         self.as_method = as_method  # attach to NDArray under this name
+        self.jit = jit  # False for data-dependent output shapes (unique...)
 
     def __repr__(self):
         return f"<op {self.name}>"
@@ -45,16 +46,19 @@ def _jitted(opdef: OpDef, kw_items: tuple):
 
 
 def jitted(opdef: OpDef, kwargs: dict):
-    """Cached XLA executable for this op + static attrs."""
+    """Cached XLA executable for this op + static attrs (eager passthrough
+    for ops whose output shape is data-dependent)."""
+    if not opdef.jit:
+        return functools.partial(opdef.fn, **kwargs)
     return _jitted(opdef, tuple(sorted(kwargs.items())))
 
 
-def register(name=None, aliases=(), as_method=None):
+def register(name=None, aliases=(), as_method=None, jit=True):
     """Register an op implementation. ``fn(*arrays, **static_attrs)``."""
 
     def deco(fn):
         opname = name or fn.__name__
-        opdef = OpDef(fn, opname, tuple(aliases), as_method)
+        opdef = OpDef(fn, opname, tuple(aliases), as_method, jit)
         _OPS[opname] = opdef
         for a in aliases:
             _OPS[a] = opdef

@@ -1,0 +1,348 @@
+"""Tensor-manipulation and math operators beyond the round-1 core.
+
+Reference surface: ``src/operator/tensor/histogram.cc``,
+``matrix_op.cc`` (depth_to_space/space_to_depth/reverse...),
+``ordering_op.cc``, ``elemwise_unary_op_basic.cc`` (erfc/digamma...),
+``ravel.cc`` (``_ravel_multi_index``/``_unravel_index``),
+``src/operator/contrib/moments.cc``, plus numpy-parity ops backing the
+``mx.np`` surface (``python/mxnet/numpy/multiarray.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+# --------------------------------------------------------------------------
+# histogram / unique / bincount / searchsorted
+# --------------------------------------------------------------------------
+
+
+@register("histogram", aliases=("_histogram",))
+def histogram(*arrays, bin_cnt=None, range=None):
+    """``histogram(data)`` with static ``bin_cnt``+``range`` attrs, or
+    ``histogram(data, bin_edges)`` (reference: ``HistogramParam``)."""
+    data = arrays[0]
+    if len(arrays) > 1:
+        edges = arrays[1]
+        cnt, edges = jnp.histogram(data, bins=edges)
+        return cnt, edges
+    cnt = 10 if bin_cnt is None else int(bin_cnt)
+    rng = tuple(range) if range is not None else None
+    cnt, edges = jnp.histogram(data, bins=cnt, range=rng)
+    return cnt, edges
+
+
+@register("unique", jit=False)
+def unique(data, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """Data-dependent output shape -> eager (dispatch skips jit)."""
+    return jnp.unique(data, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+@register("bincount", jit=False)
+def bincount(data, minlength=0):
+    return jnp.bincount(data.astype(jnp.int32),
+                        length=max(int(minlength), int(data.max()) + 1
+                                   if data.size else 1))
+
+
+@register("searchsorted")
+def searchsorted(sorted_sequence, values, side="left"):
+    return jnp.searchsorted(sorted_sequence, values, side=side)
+
+
+@register("digitize")
+def digitize(x, bins, right=False):
+    return jnp.digitize(x, bins, right=right)
+
+
+# --------------------------------------------------------------------------
+# matrix structure: tril/triu/trace/eye-like
+# --------------------------------------------------------------------------
+
+
+@register("tril", aliases=("_npi_tril",))
+def tril(data, k=0):
+    return jnp.tril(data, k=k)
+
+
+@register("triu", aliases=("_npi_triu",))
+def triu(data, k=0):
+    return jnp.triu(data, k=k)
+
+
+@register("trace", aliases=("_npi_trace",))
+def trace(data, offset=0, axis1=0, axis2=1):
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+# --------------------------------------------------------------------------
+# layout ops
+# --------------------------------------------------------------------------
+
+
+@register("roll", aliases=("_npi_roll",))
+def roll(data, shift=0, axis=None):
+    shift = tuple(shift) if isinstance(shift, (tuple, list)) else shift
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.roll(data, shift, axis=axis)
+
+
+@register("moveaxis", aliases=("_npi_moveaxis",))
+def moveaxis(data, source=0, destination=0):
+    return jnp.moveaxis(data, source, destination)
+
+
+@register("rot90", aliases=("_npi_rot90",))
+def rot90(data, k=1, axes=(0, 1)):
+    return jnp.rot90(data, k=k, axes=tuple(axes))
+
+
+@register("depth_to_space")
+def depth_to_space(data, block_size=2):
+    """NCHW: (N, C*b*b, H, W) -> (N, C, H*b, W*b) (reference DCR order)."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def space_to_depth(data, block_size=2):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("unravel_index", aliases=("_unravel_index",))
+def unravel_index(data, shape=None):
+    out = jnp.unravel_index(data.astype(jnp.int32), shape)
+    return jnp.stack(out, axis=0)
+
+
+@register("ravel_multi_index", aliases=("_ravel_multi_index",))
+def ravel_multi_index(data, shape=None):
+    idx = tuple(data[i].astype(jnp.int32) for i in range(data.shape[0]))
+    return jnp.ravel_multi_index(idx, shape, mode="clip")
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+
+
+@register("logsumexp", aliases=("_npi_logsumexp",))
+def logsumexp(data, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(
+        data, axis=None if axis is None else tuple(axis)
+        if isinstance(axis, (list, tuple)) else axis, keepdims=keepdims)
+
+
+@register("std", aliases=("_npi_std",))
+def std(data, axis=None, ddof=0, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.std(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("var", aliases=("_npi_var",))
+def var(data, axis=None, ddof=0, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.var(data, axis=axis, ddof=ddof, keepdims=keepdims)
+
+
+@register("moments", aliases=("_contrib_moments",))
+def moments(data, axes=None, keepdims=False):
+    """Return (mean, var) over ``axes`` (reference: contrib/moments.cc)."""
+    axes = None if axes is None else tuple(axes)
+    mean = jnp.mean(data, axis=axes, keepdims=keepdims)
+    var_ = jnp.var(data, axis=axes, keepdims=keepdims)
+    return mean, var_
+
+
+@register("ptp", aliases=("_npi_ptp",))
+def ptp(data, axis=None, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.ptp(data, axis=axis, keepdims=keepdims)
+
+
+@register("median", aliases=("_npi_median",))
+def median(data, axis=None, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.median(data, axis=axis, keepdims=keepdims)
+
+
+@register("quantile", aliases=("_npi_quantile",))
+def quantile(data, q, axis=None, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.quantile(data, q, axis=axis, keepdims=keepdims)
+
+
+@register("average", aliases=("_npi_average",))
+def average(*arrays, axis=None, returned=False):
+    a = arrays[0]
+    w = arrays[1] if len(arrays) > 1 else None
+    axis = tuple(axis) if isinstance(axis, (tuple, list)) else axis
+    return jnp.average(a, axis=axis, weights=w, returned=returned)
+
+
+# --------------------------------------------------------------------------
+# special functions & binary math
+# --------------------------------------------------------------------------
+
+_UNARY = {
+    "erfc": jax.scipy.special.erfc,
+    "digamma": jax.scipy.special.digamma,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "nan_to_num": jnp.nan_to_num,
+    "isposinf": lambda x: jnp.isposinf(x).astype(jnp.float32),
+    "isneginf": lambda x: jnp.isneginf(x).astype(jnp.float32),
+    "bitwise_not": lambda x: jnp.invert(x.astype(jnp.int32)),
+}
+
+for _n, _f in _UNARY.items():
+
+    def _mku(fn):
+        def op(data):
+            return fn(data)
+
+        return op
+
+    register(_n)(_mku(_f))
+
+_BINARY2 = {
+    "logaddexp": jnp.logaddexp,
+    "copysign": jnp.copysign,
+    "ldexp": lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+    "fmod": jnp.fmod,
+    "floor_divide": jnp.floor_divide,
+    "bitwise_and": lambda a, b: jnp.bitwise_and(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "bitwise_or": lambda a, b: jnp.bitwise_or(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "bitwise_xor": lambda a, b: jnp.bitwise_xor(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "left_shift": lambda a, b: jnp.left_shift(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "right_shift": lambda a, b: jnp.right_shift(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "squared_difference": lambda a, b: jnp.square(a - b),
+}
+
+for _n, _f in _BINARY2.items():
+
+    def _mkb(fn):
+        def op(lhs, rhs):
+            return fn(lhs, rhs)
+
+        return op
+
+    register(_n)(_mkb(_f))
+
+
+# --------------------------------------------------------------------------
+# products / contractions
+# --------------------------------------------------------------------------
+
+
+@register("tensordot", aliases=("_npi_tensordot",))
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(ax) if isinstance(ax, (list, tuple)) else ax
+                     for ax in axes)
+    return jnp.tensordot(a, b, axes=axes)
+
+
+@register("einsum", aliases=("_npi_einsum",))
+def einsum(*arrays, subscripts=""):
+    return jnp.einsum(subscripts, *arrays)
+
+
+@register("kron", aliases=("_npi_kron",))
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("cross", aliases=("_npi_cross",))
+def cross(a, b, axis=-1):
+    return jnp.cross(a, b, axis=axis)
+
+
+@register("outer", aliases=("_npi_outer",))
+def outer(a, b):
+    return jnp.outer(a, b)
+
+
+@register("vdot", aliases=("_npi_vdot",))
+def vdot(a, b):
+    return jnp.vdot(a, b)
+
+
+@register("inner", aliases=("_npi_inner",))
+def inner(a, b):
+    return jnp.inner(a, b)
+
+
+# --------------------------------------------------------------------------
+# cumulative
+# --------------------------------------------------------------------------
+
+
+@register("cumprod", aliases=("_npi_cumprod",))
+def cumprod(data, axis=None):
+    return jnp.cumprod(data, axis=axis)
+
+
+@register("cummax")
+def cummax(data, axis=0):
+    return lax.cummax(data, axis=axis)
+
+
+@register("cummin")
+def cummin(data, axis=0):
+    return lax.cummin(data, axis=axis)
+
+
+@register("diff", aliases=("_npi_diff",))
+def diff(data, n=1, axis=-1):
+    return jnp.diff(data, n=n, axis=axis)
+
+
+@register("ediff1d", aliases=("_npi_ediff1d",))
+def ediff1d(data):
+    return jnp.ediff1d(data)
+
+
+# --------------------------------------------------------------------------
+# activations (standalone op forms; Activation handles the classic four)
+# --------------------------------------------------------------------------
+
+_ACTS = {
+    "elu": lambda x: jax.nn.elu(x),
+    "selu": lambda x: jax.nn.selu(x),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+    "hard_swish": lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "silu": lambda x: jax.nn.silu(x),
+    "softplus": lambda x: jax.nn.softplus(x),
+}
+
+for _n, _f in _ACTS.items():
+
+    def _mka(fn):
+        def op(data):
+            return fn(data)
+
+        return op
+
+    register(_n)(_mka(_f))
+
+
+@register("prelu", aliases=("_npi_prelu",))
+def prelu(data, gamma):
+    return jnp.where(data >= 0, data, gamma * data)

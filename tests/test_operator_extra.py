@@ -1,0 +1,468 @@
+"""Round-2 operator additions: la_op linalg family, tensor/math extras,
+vision/sampling ops, detection pipeline.
+
+Reference model: tests/python/unittest/test_operator.py (forward vs numpy
++ check_numeric_gradient central differences).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+nd = mx.nd
+
+
+def _spd(n, batch=()):
+    a = np.random.rand(*batch, n, n).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + 3 * np.eye(n, dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# linalg la_op family
+# ---------------------------------------------------------------------------
+
+
+def test_linalg_trsm_trmm():
+    spd = _spd(4, (2,))
+    L = np.linalg.cholesky(spd)
+    B = np.random.rand(2, 4, 3).astype(np.float32)
+    X = nd.linalg_trsm(nd.array(L), nd.array(B), alpha=2.0).asnumpy()
+    np.testing.assert_allclose(L @ X, 2.0 * B, rtol=1e-4, atol=1e-4)
+    Y = nd.linalg_trmm(nd.array(L), nd.array(B)).asnumpy()
+    np.testing.assert_allclose(Y, np.tril(L) @ B, rtol=1e-5, atol=1e-5)
+    # rightside B (2, 3, 4): X A = B
+    B2 = np.random.rand(2, 3, 4).astype(np.float32)
+    X2 = nd.linalg_trsm(nd.array(L), nd.array(B2), rightside=True).asnumpy()
+    np.testing.assert_allclose(X2 @ L, B2, rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_potri():
+    spd = _spd(5)
+    L = np.linalg.cholesky(spd)
+    inv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(inv @ spd, np.eye(5), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_diag_trian_roundtrip():
+    a = np.random.rand(2, 4, 4).astype(np.float32)
+    d = nd.linalg_extractdiag(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(d, np.diagonal(a, axis1=-2, axis2=-1))
+    m = nd.linalg_makediag(nd.array(d)).asnumpy()
+    np.testing.assert_allclose(np.diagonal(m, axis1=-2, axis2=-1), d)
+    tri = nd.linalg_extracttrian(nd.array(a)).asnumpy()
+    assert tri.shape == (2, 10)
+    back = nd.linalg_maketrian(nd.array(tri)).asnumpy()
+    np.testing.assert_allclose(np.tril(a), back, rtol=1e-6)
+    s = nd.linalg_sumlogdiag(nd.array(_spd(4, (2,)))).asnumpy()
+    assert s.shape == (2,)
+
+
+def test_linalg_syevd_inverse_det():
+    spd = _spd(4)
+    U, L = nd.linalg_syevd(nd.array(spd))
+    U, L = U.asnumpy(), L.asnumpy()
+    np.testing.assert_allclose(U.T @ np.diag(L) @ U, spd, rtol=1e-3, atol=1e-3)
+    inv = nd.linalg_inverse(nd.array(spd)).asnumpy()
+    np.testing.assert_allclose(inv, np.linalg.inv(spd), rtol=1e-3, atol=1e-3)
+    det = float(nd.linalg_det(nd.array(spd)).asnumpy())
+    np.testing.assert_allclose(det, np.linalg.det(spd), rtol=1e-3)
+    sign, logdet = nd.linalg_slogdet(nd.array(spd))
+    np.testing.assert_allclose(float(sign.asnumpy()) * np.exp(float(logdet.asnumpy())),
+                               np.linalg.det(spd), rtol=1e-3)
+
+
+def test_linalg_gelqf_svd_solve():
+    a = np.random.rand(3, 5).astype(np.float32)
+    Lm, Q = nd.linalg_gelqf(nd.array(a))
+    Lm, Q = Lm.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(Lm @ Q, a, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), rtol=1e-4, atol=1e-4)
+    u, s, vt = nd.linalg_svd(nd.array(a))
+    np.testing.assert_allclose(
+        u.asnumpy() @ np.diag(s.asnumpy()) @ vt.asnumpy(), a,
+        rtol=1e-4, atol=1e-4)
+    spd = _spd(4)
+    b = np.random.rand(4, 2).astype(np.float32)
+    x = nd.linalg_solve(nd.array(spd), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(spd @ x, b, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_gradients():
+    spd = _spd(3)
+    check_numeric_gradient(lambda x: nd.linalg_sumlogdiag(x), [spd],
+                           rtol=1e-2, atol=1e-3)
+    L = np.linalg.cholesky(spd)
+    B = np.random.rand(3, 2).astype(np.float32)
+    check_numeric_gradient(lambda a, b: nd.linalg_trsm(a, b).sum(),
+                           [L, B], rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# histogram / unique / searchsorted
+# ---------------------------------------------------------------------------
+
+
+def test_histogram():
+    x = np.random.rand(100).astype(np.float32)
+    cnt, edges = nd.histogram(nd.array(x), bin_cnt=8, range=(0.0, 1.0))
+    ref_cnt, ref_edges = np.histogram(x, bins=8, range=(0.0, 1.0))
+    np.testing.assert_allclose(cnt.asnumpy(), ref_cnt)
+    np.testing.assert_allclose(edges.asnumpy(), ref_edges, rtol=1e-6)
+    be = np.array([0.0, 0.25, 0.5, 1.0], np.float32)
+    cnt2, _ = nd.histogram(nd.array(x), nd.array(be))
+    ref2, _ = np.histogram(x, bins=be)
+    np.testing.assert_allclose(cnt2.asnumpy(), ref2)
+
+
+def test_unique_bincount_searchsorted():
+    x = np.array([3, 1, 3, 2, 1, 7], np.float32)
+    np.testing.assert_allclose(nd.unique(nd.array(x)).asnumpy(), [1, 2, 3, 7])
+    b = nd.bincount(nd.array(np.array([0, 1, 1, 3], np.float32))).asnumpy()
+    np.testing.assert_allclose(b, [1, 2, 0, 1])
+    ss = nd.searchsorted(nd.array(np.array([1.0, 2, 3], np.float32)),
+                         nd.array(np.array([2.5], np.float32))).asnumpy()
+    assert ss[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# layout / structure ops
+# ---------------------------------------------------------------------------
+
+
+def test_tril_triu_trace():
+    x = np.random.rand(4, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.tril(nd.array(x), k=-1).asnumpy(),
+                               np.tril(x, -1))
+    np.testing.assert_allclose(nd.triu(nd.array(x), k=1).asnumpy(),
+                               np.triu(x, 1))
+    np.testing.assert_allclose(float(nd.trace(nd.array(x)).asnumpy()),
+                               np.trace(x), rtol=1e-6)
+
+
+def test_roll_moveaxis_rot90():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(nd.roll(nd.array(x), shift=2, axis=2).asnumpy(),
+                               np.roll(x, 2, 2))
+    np.testing.assert_allclose(
+        nd.moveaxis(nd.array(x), source=0, destination=2).asnumpy(),
+        np.moveaxis(x, 0, 2))
+    np.testing.assert_allclose(nd.rot90(nd.array(x), k=1, axes=(1, 2)).asnumpy(),
+                               np.rot90(x, 1, (1, 2)))
+
+
+def test_depth_space_roundtrip():
+    x = np.random.rand(2, 8, 4, 6).astype(np.float32)
+    d = nd.space_to_depth(nd.array(x), block_size=2)
+    assert d.shape == (2, 32, 2, 3)
+    back = nd.depth_to_space(d, block_size=2).asnumpy()
+    np.testing.assert_allclose(back, x)
+
+
+def test_ravel_unravel():
+    shape = (3, 4, 5)
+    flat = np.array([0, 17, 59], np.float32)
+    multi = nd.unravel_index(nd.array(flat), shape=shape).asnumpy()
+    ref = np.stack(np.unravel_index(flat.astype(np.int64), shape))
+    np.testing.assert_allclose(multi, ref)
+    back = nd.ravel_multi_index(nd.array(multi.astype(np.float32)),
+                                shape=shape).asnumpy()
+    np.testing.assert_allclose(back, flat)
+
+
+# ---------------------------------------------------------------------------
+# reductions & special functions
+# ---------------------------------------------------------------------------
+
+
+def test_reduction_extras():
+    x = np.random.rand(3, 4, 5).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.logsumexp(a, axis=1).asnumpy(),
+                               np.log(np.exp(x).sum(1)), rtol=1e-5)
+    np.testing.assert_allclose(nd.std(a, axis=0).asnumpy(), x.std(0), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(nd.var(a, axis=2).asnumpy(), x.var(2), rtol=1e-4,
+                               atol=1e-6)
+    m, v = nd.moments(a, axes=(0, 2))
+    np.testing.assert_allclose(m.asnumpy(), x.mean((0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.var((0, 2)), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(float(nd.median(a).asnumpy()), np.median(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(nd.ptp(a).asnumpy()), np.ptp(x), rtol=1e-5)
+
+
+def test_special_and_binary():
+    x = np.random.uniform(0.1, 3.0, (3, 4)).astype(np.float32)
+    y = np.random.uniform(0.1, 3.0, (3, 4)).astype(np.float32)
+    # erfc(x) = 1 - erf(x)
+    np.testing.assert_allclose(nd.erfc(nd.array(x)).asnumpy(),
+                               1.0 - nd.erf(nd.array(x)).asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.logaddexp(nd.array(x), nd.array(y)).asnumpy(),
+                               np.logaddexp(x, y), rtol=1e-5)
+    np.testing.assert_allclose(nd.copysign(nd.array(x), nd.array(-y)).asnumpy(),
+                               np.copysign(x, -y))
+    np.testing.assert_allclose(nd.fmod(nd.array(x), nd.array(y)).asnumpy(),
+                               np.fmod(x, y), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.squared_difference(nd.array(x), nd.array(y)).asnumpy(),
+        (x - y) ** 2, rtol=1e-5)
+    ints = np.array([[5, 3], [12, 10]], np.float32)
+    np.testing.assert_allclose(
+        nd.bitwise_and(nd.array(ints), nd.array(ints * 0 + 6)).asnumpy(),
+        np.bitwise_and(ints.astype(np.int32), 6))
+
+
+def test_products():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(nd.tensordot(nd.array(a), nd.array(b), axes=1).asnumpy(),
+                               np.tensordot(a, b, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.einsum(nd.array(a), nd.array(b), subscripts="ij,jk->ik").asnumpy(),
+        a @ b, rtol=1e-5)
+    np.testing.assert_allclose(nd.kron(nd.array(a), nd.array(b)).asnumpy(),
+                               np.kron(a, b), rtol=1e-5)
+    v1 = np.random.rand(3).astype(np.float32)
+    v2 = np.random.rand(3).astype(np.float32)
+    np.testing.assert_allclose(nd.cross(nd.array(v1), nd.array(v2)).asnumpy(),
+                               np.cross(v1, v2), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.outer(nd.array(v1), nd.array(v2)).asnumpy(),
+                               np.outer(v1, v2), rtol=1e-6)
+
+
+def test_cumulative():
+    x = np.random.rand(3, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.cumprod(nd.array(x), axis=1).asnumpy(),
+                               np.cumprod(x, 1), rtol=1e-5)
+    np.testing.assert_allclose(nd.cummax(nd.array(x), axis=0).asnumpy(),
+                               np.maximum.accumulate(x, 0))
+    np.testing.assert_allclose(nd.diff(nd.array(x), axis=1).asnumpy(),
+                               np.diff(x, axis=1), rtol=1e-5, atol=1e-7)
+
+
+def test_activation_extras():
+    x = np.random.randn(3, 4).astype(np.float32)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.elu(a).asnumpy(),
+                               np.where(x > 0, x, np.expm1(x)), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(nd.silu(a).asnumpy(), x / (1 + np.exp(-x)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(nd.hard_sigmoid(a).asnumpy(),
+                               np.clip(0.2 * x + 0.5, 0, 1), rtol=1e-5)
+    np.testing.assert_allclose(nd.mish(a).asnumpy(),
+                               x * np.tanh(np.log1p(np.exp(x))), rtol=1e-4,
+                               atol=1e-5)
+    g = np.full((3, 4), 0.25, np.float32)
+    np.testing.assert_allclose(nd.prelu(a, nd.array(g)).asnumpy(),
+                               np.where(x >= 0, x, 0.25 * x), rtol=1e-6)
+    check_numeric_gradient(lambda t: nd.gelu(t), [x], rtol=2e-2, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# vision / sampling ops
+# ---------------------------------------------------------------------------
+
+
+def test_upsampling():
+    x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+    up = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest").asnumpy()
+    assert up.shape == (2, 3, 8, 8)
+    np.testing.assert_allclose(up[:, :, ::2, ::2], x)
+    np.testing.assert_allclose(up[:, :, 1::2, 1::2], x)
+    bi = nd.UpSampling(nd.array(x), scale=2, sample_type="bilinear",
+                       num_filter=3).asnumpy()
+    assert bi.shape == (2, 3, 8, 8)
+
+
+def test_roi_pooling():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+    out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    np.testing.assert_allclose(out[0, 0], [[5, 7], [13, 15]])
+
+
+def test_grid_generator_bilinear_sampler_identity():
+    x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 5))
+    out = nd.BilinearSampler(nd.array(x), grid).asnumpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+    st = nd.SpatialTransformer(nd.array(x), nd.array(theta),
+                               target_shape=(5, 5)).asnumpy()
+    np.testing.assert_allclose(st, x, rtol=1e-4, atol=1e-5)
+
+
+def test_im2col_col2im():
+    x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), pad=(1, 1))
+    assert cols.shape == (2, 27, 36)
+    back = nd.col2im(cols, input_size=(3, 6, 6), kernel=(3, 3),
+                     pad=(1, 1)).asnumpy()
+    # col2im is the adjoint: interior pixels are counted 9x
+    assert back.shape == x.shape
+    np.testing.assert_allclose(back[:, :, 2:4, 2:4], 9 * x[:, :, 2:4, 2:4],
+                               rtol=1e-5)
+
+
+def test_deformable_convolution_zero_offset():
+    x = np.random.rand(2, 4, 8, 8).astype(np.float32)
+    w = (np.random.randn(6, 4, 3, 3) * 0.1).astype(np.float32)
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    dc = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                  kernel=(3, 3), pad=(1, 1), num_filter=6,
+                                  no_bias=True).asnumpy()
+    cv = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3), pad=(1, 1),
+                        num_filter=6, no_bias=True).asnumpy()
+    np.testing.assert_allclose(dc, cv, rtol=1e-4, atol=1e-5)
+    # half-pixel x-shift ~ average of neighbors on a linear ramp
+    ramp = np.tile(np.arange(8, dtype=np.float32), (1, 1, 8, 1))
+    off2 = np.zeros((1, 18, 8, 8), np.float32)
+    off2[:, 1::2] = 0.5  # x offsets
+    w1 = np.zeros((1, 1, 3, 3), np.float32)
+    w1[0, 0, 1, 1] = 1.0
+    out = nd.DeformableConvolution(nd.array(ramp), nd.array(off2),
+                                   nd.array(w1), kernel=(3, 3), pad=(1, 1),
+                                   num_filter=1, no_bias=True).asnumpy()
+    np.testing.assert_allclose(out[0, 0, 2, 2:5], [2.5, 3.5, 4.5], rtol=1e-5)
+
+
+def test_correlation_self():
+    x = np.random.rand(1, 2, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), kernel_size=1,
+                         max_displacement=1, stride1=1, stride2=1,
+                         pad_size=1).asnumpy()
+    assert out.shape[1] == 9  # 3x3 displacement grid
+    center = out[:, 4]  # zero displacement channel: mean over C of x*x
+    np.testing.assert_allclose(center[0], (x[0] ** 2).mean(0), rtol=1e-5)
+
+
+def test_regression_outputs():
+    data = np.random.randn(4, 3).astype(np.float32)
+    label = np.random.randn(4, 3).astype(np.float32)
+    d = nd.array(data)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, nd.array(label))
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), data)
+    # reference normalizes by per-sample output count (3 here), not batch
+    np.testing.assert_allclose(d.grad.asnumpy(), (data - label) / 3,
+                               rtol=1e-5, atol=1e-6)
+    with autograd.record():
+        out = nd.LogisticRegressionOutput(d, nd.array(label))
+    out.backward()
+    sig = 1 / (1 + np.exp(-data))
+    np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    np.testing.assert_allclose(d.grad.asnumpy(), (sig - label) / 3,
+                               rtol=1e-5, atol=1e-6)
+    with autograd.record():
+        out = nd.MAERegressionOutput(d, nd.array(label))
+    out.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(), np.sign(data - label) / 3,
+                               rtol=1e-5)
+
+
+def test_svm_output():
+    data = np.random.randn(4, 5).astype(np.float32)
+    label = np.array([0, 2, 1, 4], np.float32)
+    d = nd.array(data)
+    d.attach_grad()
+    with autograd.record():
+        out = nd.SVMOutput(d, nd.array(label), use_linear=True)
+    out.backward()
+    np.testing.assert_allclose(out.asnumpy(), data)  # forward = identity
+    g = d.grad.asnumpy()
+    assert g.shape == data.shape
+    # gradient sums to zero per row (pull toward true class, push others)
+    np.testing.assert_allclose(g.sum(1), np.zeros(4), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# detection pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_multibox_target():
+    anchors = np.array([[[0.0, 0.0, 0.2, 0.2], [0.4, 0.4, 0.6, 0.6],
+                         [0.7, 0.7, 0.9, 0.9]]], np.float32)
+    label = np.array([[[1.0, 0.42, 0.42, 0.62, 0.62],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 3, 3), np.float32)
+    bt, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                   nd.array(cls_pred))
+    np.testing.assert_allclose(ct.asnumpy(), [[0, 2, 0]])
+    mask = bm.asnumpy().reshape(3, 4)
+    np.testing.assert_allclose(mask[:, 0], [0, 1, 0])
+    # encoded offsets for the matched anchor: gt center (0.52) vs anchor
+    # center (0.5), variance 0.1 -> (0.02/0.2)/0.1 = 1.0
+    tgt = bt.asnumpy().reshape(3, 4)
+    np.testing.assert_allclose(tgt[1], [1.0, 1.0, 0.0, 0.0], atol=1e-4)
+
+
+def test_multibox_target_padded_labels_force_match():
+    """Padded (-1) label rows must not clobber a real gt's force-match:
+    a weak-IoU gt whose best anchor is anchor 0 still becomes a positive."""
+    anchors = np.array([[[0.0, 0.0, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]]],
+                       np.float32)
+    label = np.array([[[0.0, 0.0, 0.0, 0.15, 0.15],
+                       [-1, -1, -1, -1, -1]]], np.float32)
+    cls_pred = np.zeros((1, 2, 2), np.float32)
+    _, bm, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                  nd.array(cls_pred))
+    np.testing.assert_allclose(ct.asnumpy(), [[1, 0]])
+    np.testing.assert_allclose(bm.asnumpy().reshape(2, 4)[:, 0], [1, 0])
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.random.rand(1, 20, 2).astype(np.float32)
+    lo = anchors
+    anchors = np.concatenate([lo, lo + 0.1], axis=-1)
+    label = np.array([[[0.0, 0.05, 0.05, 0.15, 0.15]]], np.float32)
+    logits = np.random.randn(1, 4, 20).astype(np.float32)
+    _, _, ct = nd.MultiBoxTarget(nd.array(anchors), nd.array(label),
+                                 nd.array(logits), negative_mining_ratio=3.0,
+                                 negative_mining_thresh=0.0)
+    vals = ct.asnumpy()
+    assert ((vals == -1) | (vals >= 0)).all()
+    assert (vals == -1).sum() > 0  # some anchors ignored by mining
+
+
+def test_multibox_detection():
+    anchors = np.array([[[0.1, 0.1, 0.3, 0.3], [0.11, 0.11, 0.31, 0.31],
+                         [0.6, 0.6, 0.8, 0.8]]], np.float32)
+    # C=3 (bg + 2 classes); anchors 0,1 strongly class 1; anchor 2 class 2
+    cls_prob = np.array([[[0.05, 0.1, 0.2], [0.9, 0.85, 0.1],
+                          [0.05, 0.05, 0.7]]], np.float32)
+    loc = np.zeros((1, 12), np.float32)
+    out = nd.MultiBoxDetection(nd.array(cls_prob), nd.array(loc),
+                               nd.array(anchors), nms_threshold=0.5).asnumpy()
+    assert out.shape == (1, 3, 6)
+    rows = out[0]
+    kept = rows[rows[:, 0] >= 0]
+    # NMS suppressed the overlapping duplicate of class 0 (first fg class)
+    assert len(kept) == 2
+    assert set(kept[:, 0].tolist()) == {0.0, 1.0}
+    top = rows[0]
+    np.testing.assert_allclose(top[1], 0.9, rtol=1e-6)
+    np.testing.assert_allclose(top[2:], [0.1, 0.1, 0.3, 0.3], atol=1e-5)
+
+
+def test_proposal():
+    np.random.seed(0)
+    cp = np.random.rand(2, 24, 4, 4).astype(np.float32)
+    bp = (np.random.randn(2, 48, 4, 4) * 0.1).astype(np.float32)
+    info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois = nd.Proposal(nd.array(cp), nd.array(bp), nd.array(info),
+                       rpn_pre_nms_top_n=60, rpn_post_nms_top_n=8,
+                       feature_stride=16).asnumpy()
+    assert rois.shape == (16, 5)
+    assert (rois[:8, 0] == 0).all() and (rois[8:, 0] == 1).all()
+    assert (rois[:, 1] <= rois[:, 3]).all() and (rois[:, 2] <= rois[:, 4]).all()
+    assert (rois[:, 1:] >= 0).all() and (rois[:, 1:] <= 63).all()
