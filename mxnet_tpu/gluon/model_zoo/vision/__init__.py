@@ -29,6 +29,7 @@ from .mobilenet import (  # noqa: F401
     mobilenet_v2_1_0, mobilenet_v2_0_75, mobilenet_v2_0_5, mobilenet_v2_0_25,
 )
 from .inception import Inception3, inception_v3  # noqa: F401
+from .ssd import SSD, SSDLoss, ssd_tiny, ssd_300  # noqa: F401
 
 _models = {
     "resnet18_v1": resnet18_v1,
@@ -54,6 +55,8 @@ _models = {
     "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
     "inceptionv3": inception_v3,
+    "ssd_tiny": ssd_tiny,
+    "ssd_300": ssd_300,
 }
 
 

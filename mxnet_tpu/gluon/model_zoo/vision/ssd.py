@@ -1,0 +1,130 @@
+"""SSD single-shot detector (reference models: GluonCV ``ssd_300_vgg16``
+family driven by ``src/operator/contrib/multibox_*.cc``; BASELINE.json
+config #2 names the detection path).
+
+TPU-first: every stage is static-shape — anchors are generated per
+feature map by ``MultiBoxPrior``, training targets by ``MultiBoxTarget``
+(dense IoU matching), and inference by ``MultiBoxDetection`` (fixed
+trip-count NMS) — so train and predict both compile to single XLA
+programs.
+"""
+
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ...nn import (
+    Activation,
+    BatchNorm,
+    Conv2D,
+    HybridSequential,
+    MaxPool2D,
+)
+from ... import loss as gloss
+
+
+def _conv_block(channels, stride=1):
+    out = HybridSequential(prefix="")
+    out.add(Conv2D(channels, 3, strides=stride, padding=1, use_bias=False))
+    out.add(BatchNorm())
+    out.add(Activation("relu"))
+    return out
+
+
+class SSD(HybridBlock):
+    """Compact SSD: a strided conv backbone emitting ``len(sizes)`` feature
+    scales, each with class + box prediction heads and multibox priors.
+
+    Outputs of ``hybrid_forward``: (anchors (1, N, 4), cls_preds
+    (B, num_classes+1, N), box_preds (B, N*4)) — exactly the trio
+    MultiBoxTarget / MultiBoxDetection consume.
+    """
+
+    def __init__(self, classes=20, base_channels=(16, 32, 64),
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619)),
+                 ratios=((1.0, 2.0, 0.5),) * 3, **kwargs):
+        super().__init__(**kwargs)
+        assert len(sizes) == len(ratios)
+        self.classes = classes
+        self.sizes = tuple(tuple(s) for s in sizes)
+        self.ratios = tuple(tuple(r) for r in ratios)
+        num_anchors = [len(s) + len(r) - 1
+                       for s, r in zip(self.sizes, self.ratios)]
+        with self.name_scope():
+            self.stem = HybridSequential(prefix="stem_")
+            for c in base_channels:
+                self.stem.add(_conv_block(c))
+                self.stem.add(MaxPool2D(2))
+            self.stages = HybridSequential(prefix="stages_")
+            self.cls_heads = HybridSequential(prefix="cls_")
+            self.box_heads = HybridSequential(prefix="box_")
+            c = base_channels[-1]
+            for i in range(len(self.sizes)):
+                if i > 0:
+                    self.stages.add(_conv_block(c, stride=2))
+                else:
+                    self.stages.add(HybridSequential(prefix=""))
+                self.cls_heads.add(Conv2D(num_anchors[i] * (classes + 1), 3,
+                                          padding=1))
+                self.box_heads.add(Conv2D(num_anchors[i] * 4, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        feat = self.stem(x)
+        anchors, cls_preds, box_preds = [], [], []
+        for stage, cls_head, box_head in zip(self.stages, self.cls_heads,
+                                             self.box_heads):
+            feat = stage(feat)
+            i = len(anchors)
+            anchors.append(F.MultiBoxPrior(feat, sizes=self.sizes[i],
+                                           ratios=self.ratios[i]))
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1)
+            cp = F.transpose(cls_head(feat), axes=(0, 2, 3, 1))
+            cls_preds.append(F.reshape(cp, (0, -1, self.classes + 1)))
+            bp = F.transpose(box_head(feat), axes=(0, 2, 3, 1))
+            box_preds.append(F.reshape(bp, (0, -1)))
+        anchor = F.reshape(F.concat(*anchors, dim=1), (1, -1, 4)) \
+            if len(anchors) > 1 else anchors[0]
+        cls_pred = F.concat(*cls_preds, dim=1) if len(cls_preds) > 1 \
+            else cls_preds[0]
+        box_pred = F.concat(*box_preds, dim=1) if len(box_preds) > 1 \
+            else box_preds[0]
+        # cls to (B, C+1, N) layout for MultiBoxTarget/Detection
+        cls_pred = F.transpose(cls_pred, axes=(0, 2, 1))
+        return anchor, cls_pred, box_pred
+
+
+class SSDLoss:
+    """SSD training objective: softmax CE on matched classes (ignoring
+    mined-out anchors) + smooth-L1 on encoded box offsets."""
+
+    def __init__(self, lambd=1.0, **target_kwargs):
+        self._lambd = lambd
+        self._target_kwargs = target_kwargs
+
+    def __call__(self, anchor, cls_pred, box_pred, label):
+        from ....ndarray import op as ndop
+
+        box_t, box_m, cls_t = ndop.MultiBoxTarget(
+            anchor, label, cls_pred, **self._target_kwargs)
+        # per-anchor CE with mined-out (-1) anchors contributing zero
+        valid = cls_t >= 0
+        logp = ndop.log_softmax(cls_pred, axis=1)  # (B, C+1, N)
+        picked = ndop.pick(logp, cls_t * valid, axis=1)  # (B, N)
+        cls_loss = -(picked * valid).mean()
+        l1 = gloss.HuberLoss(rho=1.0)
+        box_loss = l1(box_pred * box_m, box_t)
+        return cls_loss + self._lambd * box_loss.mean()
+
+
+def ssd_tiny(classes=20, **kwargs):
+    """Small SSD for CI-scale training (3 scales, 16-64 channels)."""
+    return SSD(classes=classes, **kwargs)
+
+
+def ssd_300(classes=20, **kwargs):
+    """SSD-300-ish capacity: deeper stem + 6 scales (reference:
+    GluonCV ssd_300)."""
+    sizes = ((0.1, 0.141), (0.2, 0.272), (0.37, 0.447), (0.54, 0.619),
+             (0.71, 0.79), (0.88, 0.961))
+    ratios = ((1.0, 2.0, 0.5),) * 2 + ((1.0, 2.0, 0.5, 3.0, 1.0 / 3),) * 4
+    return SSD(classes=classes, base_channels=(32, 48, 64),
+               sizes=sizes, ratios=ratios, **kwargs)
