@@ -21,18 +21,31 @@ def _require_onnx():
         ) from e
 
 
+def _unsupported(what):
+    raise MXNetError(
+        f"onnx.{what} is not implemented in this build (the reference's "
+        "converter maps per-op to onnx nodes; no TPU-side consumer exists "
+        "here). Supported interchange: HybridBlock.export -> symbol JSON + "
+        ".params, loaded via SymbolBlock.imports."
+    )
+
+
 def export_model(sym, params, input_shape, input_type=None,
                  onnx_file_path="model.onnx", verbose=False):
     _require_onnx()
+    _unsupported("export_model")
 
 
 def import_model(model_file):
     _require_onnx()
+    _unsupported("import_model")
 
 
 def import_to_gluon(model_file, ctx=None):
     _require_onnx()
+    _unsupported("import_to_gluon")
 
 
 def get_model_metadata(model_file):
     _require_onnx()
+    _unsupported("get_model_metadata")

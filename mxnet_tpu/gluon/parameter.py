@@ -22,8 +22,19 @@ class DeferredInitializationError(MXNetError):
     """Parameter accessed before its shape is known."""
 
 
+def _unknown_dim(s):
+    """Unknown-dim sentinel depends on shape semantics (reference:
+    ``mx.util.is_np_shape``): legacy uses 0, numpy semantics use -1 (and 0
+    is a real empty dimension)."""
+    from ..util import is_np_shape
+
+    if s is None:
+        return True
+    return s == -1 if is_np_shape() else s <= 0
+
+
 def _shape_known(shape):
-    return shape is not None and all(s and s > 0 for s in shape)
+    return shape is not None and not any(_unknown_dim(s) for s in shape)
 
 
 class Parameter:
@@ -59,25 +70,22 @@ class Parameter:
         if self._shape is None:
             self._shape = tuple(new_shape)
             return
-        if len(self._shape) != len(new_shape) or any(
-            s not in (0, u) and u != 0 for s, u in zip(self._shape, new_shape)
-        ):
-            # allow filling unknown (0) dims only
-            merged = []
-            for s, u in zip(self._shape, new_shape):
-                if s in (0, None):
-                    merged.append(u)
-                elif u in (0, None) or s == u:
-                    merged.append(s)
-                else:
-                    raise MXNetError(
-                        f"Cannot change shape of {self.name} from {self._shape} to {new_shape}"
-                    )
-            self._shape = tuple(merged)
-        else:
-            self._shape = tuple(
-                u if s in (0, None) else s for s, u in zip(self._shape, new_shape)
-            )
+        if len(self._shape) != len(new_shape):
+            raise MXNetError(
+                f"Cannot change shape of {self.name} from {self._shape} "
+                f"to {new_shape}")
+        # allow filling unknown dims only (0 legacy / -1 np semantics)
+        merged = []
+        for s, u in zip(self._shape, new_shape):
+            if _unknown_dim(s):
+                merged.append(u)
+            elif _unknown_dim(u) or s == u:
+                merged.append(s)
+            else:
+                raise MXNetError(
+                    f"Cannot change shape of {self.name} from {self._shape} to {new_shape}"
+                )
+        self._shape = tuple(merged)
         if self._deferred_init is not None and _shape_known(self._shape):
             self._finish_deferred_init()
 

@@ -5,6 +5,11 @@ storage (``kRowSparseStorage``, ``kCSRStorage``). XLA has no sparse
 storage; TPU-native emulation (SURVEY.md §7.5): RowSparse = (indices,
 values) pair with segment-sum combine; CSR = (indptr, indices, data).
 Dense fallback is always available via ``tostype('default')``.
+
+Index dtype: int32, by design. The reference stores int64 indices, but
+XLA's native index width on TPU is int32 and JAX truncates int64 without
+x64 mode; embedding tables beyond 2^31 rows are out of scope, so indices
+are int32 end-to-end (no silent-truncation warnings, faster gathers).
 """
 
 from __future__ import annotations
@@ -29,7 +34,7 @@ class RowSparseNDArray(BaseSparseNDArray):
     def __init__(self, data, indices, shape, ctx=None):
         self._values = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
         self._indices = indices if isinstance(indices, NDArray) else \
-            NDArray(jnp.asarray(indices, dtype=jnp.int64))
+            NDArray(jnp.asarray(indices, dtype=jnp.int32))
         self._sshape = tuple(shape)
         super().__init__(self._to_dense_raw(), ctx=ctx)
 
@@ -73,9 +78,9 @@ class CSRNDArray(BaseSparseNDArray):
     def __init__(self, data, indptr, indices, shape, ctx=None):
         self._values = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
         self._indptr = indptr if isinstance(indptr, NDArray) else \
-            NDArray(jnp.asarray(indptr, dtype=jnp.int64))
+            NDArray(jnp.asarray(indptr, dtype=jnp.int32))
         self._indices = indices if isinstance(indices, NDArray) else \
-            NDArray(jnp.asarray(indices, dtype=jnp.int64))
+            NDArray(jnp.asarray(indices, dtype=jnp.int32))
         self._sshape = tuple(shape)
         super().__init__(self._to_dense_raw(), ctx=ctx)
 

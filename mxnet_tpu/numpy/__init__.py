@@ -40,11 +40,49 @@ def _wrap(r):
     return r
 
 
+def _call_recorded(jfn, name, args, kwargs):
+    """Execute with tape recording so ``mx.np`` composes with autograd
+    exactly like op dispatch (reference: every mx.np op registers a
+    gradient; here the vjp is taken over the whole call)."""
+    import jax
+
+    from .. import autograd
+
+    is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=is_nd)
+    tracked = [i for i, l in enumerate(leaves)
+               if is_nd(l) and autograd.is_tracked(l)] \
+        if autograd.is_recording() else []
+
+    def rebuild(raws):
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, raws)
+        return jfn(*a2, **k2)
+
+    raws = [l.data if is_nd(l) else l for l in leaves]
+    if not tracked:
+        return _wrap(rebuild(raws))
+
+    def g(*t):
+        full = list(raws)
+        for i, v in zip(tracked, t):
+            full[i] = v
+        return rebuild(full)
+
+    res, vjp_fn = jax.vjp(g, *[leaves[i].data for i in tracked])
+    result = _wrap(res)
+    outs = list(result) if isinstance(result, (list, tuple)) else [result]
+    node = autograd.TapeNode(vjp_fn, [leaves[i] for i in tracked],
+                             len(outs), name=f"np.{name}")
+    node.out_arrays = list(outs)
+    for k, o in enumerate(outs):
+        if isinstance(o, NDArray):
+            o._ag = (node, k)
+    return result
+
+
 def _make(jfn, name):
     def f(*args, **kwargs):
-        args = tuple(_unwrap(a) for a in args)
-        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
-        return _wrap(jfn(*args, **kwargs))
+        return _call_recorded(jfn, name, args, kwargs)
 
     f.__name__ = name
     f.__doc__ = getattr(jfn, "__doc__", None)

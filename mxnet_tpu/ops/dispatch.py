@@ -29,6 +29,23 @@ def _maybe_sync(res):
     return res
 
 
+def _run_timed(opdef, fn, raw):
+    """Execute ``fn(*raw)``; with profiler aggregate stats on, block and
+    attribute wall time to the op (reference: ``AggregateStats`` hooks in
+    the engine's operator execution path)."""
+    from .. import profiler
+
+    if not profiler.aggregate_enabled():
+        return fn(*raw)
+    import time
+
+    t0 = time.perf_counter()
+    res = fn(*raw)
+    jax.block_until_ready(res)
+    profiler.record_op(opdef.name, time.perf_counter() - t0)
+    return res
+
+
 def _unwrap(x):
     from ..ndarray.ndarray import NDArray
 
@@ -55,7 +72,7 @@ def apply_op(opdef: OpDef, args, kwargs, out=None):
         if tracked_idx:
             return _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out)
 
-    res = _maybe_sync(jitted(opdef, kwargs)(*raw))
+    res = _maybe_sync(_run_timed(opdef, jitted(opdef, kwargs), raw))
     return _wrap_result(res, ctx, out)
 
 
@@ -71,7 +88,7 @@ def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
             full[i] = v
         return fn(*full)
 
-    res, vjp_fn = jax.vjp(f, *tracked_raw)
+    res, vjp_fn = _run_timed(opdef, lambda *t: jax.vjp(f, *t), tracked_raw)
     _maybe_sync(res)
     result = _wrap_result(res, ctx, out)
     outs = result if isinstance(result, (list, tuple)) else [result]

@@ -15,6 +15,25 @@ import jax
 _config = {"profile_all": False, "filename": "profile.json", "aggregate_stats": False}
 _state = {"running": False, "dir": None}
 _records = []
+_AGGREGATE = {}  # op name -> [count, total_s, min_s, max_s]
+
+
+def aggregate_enabled() -> bool:
+    """True when per-op aggregate timing is on (set_config(aggregate_stats=
+    True)). Op dispatch then blocks per call to attribute device time
+    (reference: ``AggregateStats``, engine-integrated)."""
+    return bool(_config.get("aggregate_stats"))
+
+
+def record_op(name: str, dt: float) -> None:
+    rec = _AGGREGATE.get(name)
+    if rec is None:
+        _AGGREGATE[name] = [1, dt, dt, dt]
+    else:
+        rec[0] += 1
+        rec[1] += dt
+        rec[2] = min(rec[2], dt)
+        rec[3] = max(rec[3], dt)
 
 
 def set_config(**kwargs):
@@ -60,8 +79,28 @@ def dump(finished=True, profile_process="worker"):
     return _state["dir"]
 
 
-def dumps(reset=False):
-    return "\n".join(f"{n}: {d * 1e3:.3f} ms" for n, d in _records)
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    """Aggregate statistics as a printable table (reference:
+    ``mx.profiler.dumps(aggregate_stats=True)`` -> ``AggregateStats``
+    Name / Total Count / Time (ms) / Min / Max / Avg columns)."""
+    lines = []
+    if _AGGREGATE:
+        lines.append("Profile Statistics:")
+        lines.append(f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+                     f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
+        key = {"total": lambda kv: kv[1][1], "count": lambda kv: kv[1][0],
+               "avg": lambda kv: kv[1][1] / kv[1][0]}.get(
+                   sort_by, lambda kv: kv[1][1])
+        for name, (cnt, tot, mn, mx) in sorted(
+                _AGGREGATE.items(), key=key, reverse=not ascending):
+            lines.append(f"{name:<40}{cnt:>12}{tot * 1e3:>14.4f}"
+                         f"{mn * 1e3:>12.4f}{mx * 1e3:>12.4f}"
+                         f"{tot / cnt * 1e3:>12.4f}")
+    lines.extend(f"{n}: {d * 1e3:.3f} ms" for n, d in _records)
+    if reset:
+        _AGGREGATE.clear()
+        _records.clear()
+    return "\n".join(lines)
 
 
 class ProfileTask:

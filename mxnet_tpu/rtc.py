@@ -3,7 +3,7 @@
 
 TPU-native: user runtime kernels are Pallas kernels, not CUDA C. The
 ``CudaModule`` API raises with a pointer to the pallas path; see
-``mxnet_tpu.ops.pallas_kernels`` for the in-tree TPU kernels.
+``mxnet_tpu/ops/flash_attention.py`` for the in-tree Pallas TPU kernel.
 """
 
 from __future__ import annotations
@@ -15,7 +15,7 @@ class CudaModule:
     def __init__(self, source, options=(), exports=()):
         raise MXNetError(
             "CUDA RTC is not applicable on TPU. Write a Pallas kernel "
-            "instead (see mxnet_tpu/ops/pallas_kernels.py and "
+            "instead (see mxnet_tpu/ops/flash_attention.py and "
             "jax.experimental.pallas); XLA already fuses pointwise chains "
             "that the reference needed RTC for."
         )

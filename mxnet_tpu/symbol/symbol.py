@@ -455,9 +455,12 @@ def _infer_params_for_node(node, in_shapes):
     return out
 
 
-def _infer_graph_shapes(root, known_shapes):
+def _infer_graph_shapes(root, known_shapes, return_node_map=False):
     """Fixed-point shape inference: forward abstract eval where inputs are
-    known; layer-specific parameter deduction where they aren't."""
+    known; layer-specific parameter deduction where they aren't.
+
+    With ``return_node_map`` also returns the per-node output-shape map
+    (id(node) -> [shape, ...]) — used by ``visualization.print_summary``."""
     import jax
     import jax.numpy as jnp
 
@@ -543,4 +546,6 @@ def _infer_graph_shapes(root, known_shapes):
                                   if h._num_outputs > 1 else shapes[0])
     arg_out = {n: known_shapes.get(n) for n in root.list_arguments()}
     aux_out = {n: known_shapes.get(n) for n in root.list_auxiliary_states()}
+    if return_node_map:
+        return out_shapes, arg_out, aux_out, node_out
     return out_shapes, arg_out, aux_out

@@ -1,13 +1,31 @@
-"""Network visualization (reference: ``python/mxnet/visualization.py``)."""
+"""Network visualization (reference: ``python/mxnet/visualization.py``,
+symbols ``print_summary`` / ``plot_network``)."""
 
 from __future__ import annotations
 
 from .base import MXNetError
 
 
-def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.74, 1.0)):
-    """Print a layer table for a Symbol graph (reference: ``print_summary``)."""
-    nodes = symbol.get_internals().list_outputs() if hasattr(symbol, "get_internals") else []
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(0.44, 0.64, 0.74, 1.0)):
+    """Print a layer table with real output shapes and parameter counts,
+    computed by the symbol graph's fixed-point shape inference
+    (reference: ``print_summary`` over ``nnvm`` graph attributes).
+
+    ``shape``: dict of input-variable name -> shape, e.g.
+    ``{"data": (1, 3, 224, 224)}``.
+    """
+    from .symbol.symbol import Symbol, _infer_graph_shapes
+
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol")
+    known = dict(shape or {})
+    _, arg_shapes, _, node_out = _infer_graph_shapes(
+        symbol, dict(known), return_node_map=True)
+    # merge deduced parameter shapes back in for param counting
+    merged = {k: v for k, v in arg_shapes.items() if v is not None}
+    merged.update({k: v for k, v in known.items()})
+
     header = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
     positions = [int(line_length * p) for p in positions]
 
@@ -17,17 +35,46 @@ def print_summary(symbol, shape=None, line_length=120, positions=(0.44, 0.64, 0.
             line += str(f)
             line = line[: positions[i]]
             line += " " * (positions[i] - len(line))
-        print(line)
+        print(line.rstrip())
+
+    def fmt_shape(s):
+        return "x".join(str(d) for d in s) if s else "-"
+
+    def nparams(s):
+        n = 1
+        for d in s:
+            n *= d
+        return n
 
     print("_" * line_length)
     print_row(header)
     print("=" * line_length)
     total = 0
-    for node in getattr(symbol, "_graph_nodes", lambda: [])() if callable(getattr(symbol, "_graph_nodes", None)) else []:
-        print_row([f"{node.name} ({node.op})", "-", 0, ",".join(i.name for i in node.inputs)])
+    data_inputs = set(known)
+    counted = set()
+    for node in symbol._topo():
+        if node._op in (None, "_group"):
+            continue
+        shapes = node_out.get(id(node))
+        out_s = fmt_shape(shapes[0]) if shapes else "-"
+        # parameters: variable inputs of this node that aren't data inputs
+        p = 0
+        prev = []
+        for inp in node._inputs:
+            if inp._op is None:
+                if inp._name in data_inputs:
+                    prev.append(inp._name)
+                elif inp._name in merged and inp._name not in counted:
+                    p += nparams(merged[inp._name])
+                    counted.add(inp._name)
+            else:
+                prev.append(inp._name)
+        total += p
+        print_row([f"{node._name} ({node._op})", out_s, p, ",".join(prev)])
     print("=" * line_length)
     print(f"Total params: {total}")
     print("_" * line_length)
+    return total
 
 
 def plot_network(symbol, title="plot", save_format="pdf", shape=None,

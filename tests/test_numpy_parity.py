@@ -1,0 +1,239 @@
+"""``mx.np`` / ``mx.npx`` parity sweep against real NumPy.
+
+Reference model: ``tests/python/unittest/test_numpy_op.py`` +
+``test_numpy_interoperability.py`` — every function is exercised with
+representative inputs and compared elementwise to the NumPy oracle.
+"""
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.numpy as np
+import mxnet_tpu.numpy_extension as npx
+
+RTOL, ATOL = 1e-5, 1e-6
+
+
+def _chk(mx_out, np_out, rtol=RTOL, atol=ATOL):
+    mx_arr = mx_out.asnumpy() if hasattr(mx_out, "asnumpy") else onp.asarray(mx_out)
+    onp.testing.assert_allclose(mx_arr, np_out, rtol=rtol, atol=atol)
+
+
+A = onp.random.RandomState(7).rand(3, 4).astype(onp.float32)
+B = onp.random.RandomState(8).rand(3, 4).astype(onp.float32)
+V = onp.random.RandomState(9).rand(5).astype(onp.float32)
+
+UNARY = [
+    "exp", "expm1", "log1p", "sqrt", "cbrt", "square", "sin", "cos", "tan",
+    "arcsin", "arctan", "sinh", "cosh", "tanh", "arcsinh", "floor", "ceil",
+    "trunc", "rint", "sign", "negative", "reciprocal", "degrees", "radians",
+    "abs", "fabs", "isnan", "isinf", "isfinite", "real", "conj",
+]
+
+BINARY = [
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "power", "mod", "remainder", "fmod", "maximum", "minimum", "fmax",
+    "fmin", "arctan2", "hypot", "logical_and", "logical_or", "logical_xor",
+    "copysign", "nextafter", "equal", "not_equal", "greater", "less",
+    "greater_equal", "less_equal",
+]
+
+REDUCTIONS = [
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax",
+    "median", "ptp", "argmin", "argmax", "any", "all", "count_nonzero",
+    "nansum", "nanprod", "nanmean", "nanmin", "nanmax",
+]
+
+
+@pytest.mark.parametrize("name", UNARY)
+def test_np_unary(name):
+    _chk(getattr(np, name)(np.array(A)), getattr(onp, name)(A))
+
+
+@pytest.mark.parametrize("name", BINARY)
+def test_np_binary(name):
+    _chk(getattr(np, name)(np.array(A), np.array(B)),
+         getattr(onp, name)(A, B))
+
+
+@pytest.mark.parametrize("name", REDUCTIONS)
+def test_np_reduction(name):
+    _chk(getattr(np, name)(np.array(A)), getattr(onp, name)(A))
+    if name not in ("count_nonzero",):
+        _chk(getattr(np, name)(np.array(A), axis=1),
+             getattr(onp, name)(A, axis=1))
+
+
+def test_np_creation():
+    _chk(np.zeros((2, 3)), onp.zeros((2, 3)))
+    _chk(np.ones((2, 3)), onp.ones((2, 3)))
+    _chk(np.full((2, 2), 7.0), onp.full((2, 2), 7.0))
+    _chk(np.arange(2, 10, 2), onp.arange(2, 10, 2))
+    _chk(np.linspace(0, 1, 7), onp.linspace(0, 1, 7))
+    _chk(np.logspace(0, 2, 5), onp.logspace(0, 2, 5), rtol=1e-4)
+    _chk(np.eye(4, k=1), onp.eye(4, k=1))
+    _chk(np.identity(3), onp.identity(3))
+    _chk(np.tri(3), onp.tri(3))
+    _chk(np.zeros_like(np.array(A)), onp.zeros_like(A))
+    _chk(np.full_like(np.array(A), 2.5), onp.full_like(A, 2.5))
+
+
+def test_np_manipulation():
+    a = np.array(A)
+    _chk(np.reshape(a, (4, 3)), A.reshape(4, 3))
+    _chk(np.ravel(a), A.ravel())
+    _chk(np.transpose(a), A.T)
+    _chk(np.expand_dims(a, 1), onp.expand_dims(A, 1))
+    _chk(np.squeeze(np.expand_dims(a, 0)), A)
+    _chk(np.concatenate([a, a], axis=0), onp.concatenate([A, A], 0))
+    _chk(np.stack([a, a], axis=1), onp.stack([A, A], 1))
+    _chk(np.vstack([a, a]), onp.vstack([A, A]))
+    _chk(np.hstack([a, a]), onp.hstack([A, A]))
+    _chk(np.tile(a, (2, 1)), onp.tile(A, (2, 1)))
+    _chk(np.repeat(a, 2, axis=0), onp.repeat(A, 2, 0))
+    _chk(np.flip(a, axis=1), onp.flip(A, 1))
+    _chk(np.roll(a, 1, axis=0), onp.roll(A, 1, 0))
+    _chk(np.rot90(a), onp.rot90(A))
+    _chk(np.pad(a, ((1, 1), (0, 0))), onp.pad(A, ((1, 1), (0, 0))))
+    _chk(np.broadcast_to(np.array(V), (3, 5)), onp.broadcast_to(V, (3, 5)))
+    _chk(np.atleast_2d(np.array(V)), onp.atleast_2d(V))
+    parts = np.split(a, 2, axis=1)
+    ref = onp.split(A, 2, 1)
+    for p, r in zip(parts, ref):
+        _chk(p, r)
+
+
+def test_np_sorting_searching():
+    _chk(np.sort(np.array(V)), onp.sort(V))
+    _chk(np.argsort(np.array(V)), onp.argsort(V))
+    _chk(np.searchsorted(np.sort(np.array(V)), np.array(V)),
+         onp.searchsorted(onp.sort(V), V))
+    _chk(np.unique(np.array([1.0, 3.0, 1.0, 2.0])),
+         onp.unique([1.0, 3.0, 1.0, 2.0]))
+    _chk(np.where(np.array(A) > 0.5, np.array(A), np.array(B)),
+         onp.where(A > 0.5, A, B))
+    _chk(np.nonzero(np.array([0.0, 1.0, 0.0, 2.0]))[0],
+         onp.nonzero([0.0, 1.0, 0.0, 2.0])[0])
+    _chk(np.argwhere(np.array(A) > 0.5), onp.argwhere(A > 0.5))
+
+
+def test_np_linalg_products():
+    a, b = np.array(A), np.array(B)
+    _chk(np.dot(a, b.T), A @ B.T, rtol=1e-4)
+    _chk(np.matmul(a, b.T), A @ B.T, rtol=1e-4)
+    _chk(np.einsum("ij,kj->ik", a, b), onp.einsum("ij,kj->ik", A, B),
+         rtol=1e-4)
+    _chk(np.tensordot(a, b, axes=([1], [1])),
+         onp.tensordot(A, B, ([1], [1])), rtol=1e-4)
+    _chk(np.inner(a, b), onp.inner(A, B), rtol=1e-4)
+    _chk(np.outer(np.array(V), np.array(V)), onp.outer(V, V))
+    _chk(np.kron(a, b), onp.kron(A, B), rtol=1e-4)
+    _chk(np.trace(np.array(A[:3, :3])), onp.trace(A[:3, :3]))
+    _chk(np.cross(np.array(V[:3]), np.array(V[1:4])),
+         onp.cross(V[:3], V[1:4]))
+
+
+def test_np_linalg_module():
+    spd = (A[:3, :3] @ A[:3, :3].T + 3 * onp.eye(3)).astype(onp.float32)
+    _chk(np.linalg.norm(np.array(A)), onp.linalg.norm(A), rtol=1e-4)
+    _chk(np.linalg.inv(np.array(spd)), onp.linalg.inv(spd), rtol=1e-3,
+         atol=1e-4)
+    _chk(np.linalg.det(np.array(spd)), onp.linalg.det(spd), rtol=1e-3)
+    _chk(np.linalg.cholesky(np.array(spd)), onp.linalg.cholesky(spd),
+         rtol=1e-3, atol=1e-4)
+    w_mx = np.linalg.eigvalsh(np.array(spd))
+    _chk(np.sort(w_mx), onp.sort(onp.linalg.eigvalsh(spd)), rtol=1e-3,
+         atol=1e-4)
+    x = np.linalg.solve(np.array(spd), np.array(V[:3]))
+    onp.testing.assert_allclose(spd @ x.asnumpy(), V[:3], rtol=1e-3,
+                                atol=1e-4)
+    u, s, vt = np.linalg.svd(np.array(A))
+    onp.testing.assert_allclose(
+        u.asnumpy()[:, :3] @ onp.diag(s.asnumpy()) @ vt.asnumpy()[:3], A,
+        rtol=1e-3, atol=1e-4)
+
+
+def test_np_statistics():
+    _chk(np.percentile(np.array(V), 50), onp.percentile(V, 50))
+    _chk(np.quantile(np.array(V), 0.25), onp.quantile(V, 0.25), rtol=1e-4)
+    _chk(np.average(np.array(V), weights=np.array(V)),
+         onp.average(V, weights=V), rtol=1e-4)
+    _chk(np.cov(np.array(A)), onp.cov(A), rtol=1e-4)
+    _chk(np.corrcoef(np.array(A)), onp.corrcoef(A), rtol=1e-4)
+    cnt, edges = np.histogram(np.array(V), 4)
+    rcnt, redges = onp.histogram(V, 4)
+    _chk(cnt, rcnt)
+    _chk(edges, redges, rtol=1e-5)
+    _chk(np.bincount(np.array([0, 1, 1, 3])), onp.bincount([0, 1, 1, 3]))
+    _chk(np.diff(np.array(V)), onp.diff(V))
+    _chk(np.gradient(np.array(V)), onp.gradient(V), rtol=1e-4)
+    _chk(np.interp(np.array([1.5]), np.array([1.0, 2.0]),
+                   np.array([10.0, 20.0])), [15.0])
+    _chk(np.convolve(np.array(V), np.array([1.0, 0.5])),
+         onp.convolve(V, [1.0, 0.5]), rtol=1e-4)
+
+
+def test_np_indexing_functions():
+    a = np.array(A)
+    _chk(np.take(a, np.array([0, 2]), axis=0), onp.take(A, [0, 2], 0))
+    _chk(np.take_along_axis(a, np.argsort(a, axis=1), axis=1),
+         onp.take_along_axis(A, onp.argsort(A, 1), 1))
+    _chk(np.compress(np.array([True, False, True]), a, axis=0),
+         onp.compress([True, False, True], A, 0))
+    idx = np.unravel_index(np.array([5, 11]), (3, 4))
+    ref = onp.unravel_index([5, 11], (3, 4))
+    for i, r in zip(idx, ref):
+        _chk(i, r)
+
+
+def test_np_array_interop():
+    """mx.np arrays are framework NDArrays: autograd + Gluon interop."""
+    from mxnet_tpu import autograd
+
+    a = np.array(A)
+    assert isinstance(a, mx.nd.NDArray)
+    a.attach_grad()
+    with autograd.record():
+        out = (np.sin(a) * np.array(B)).sum()
+    out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.cos(A) * B, rtol=1e-5)
+
+
+def test_npx_surface():
+    x = np.array(A)
+    s = npx.softmax(x, axis=-1).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(3), rtol=1e-5)
+    r = npx.relu(np.array(A - 0.5)).asnumpy()
+    assert (r >= 0).all()
+    t = npx.topk(x, k=2, axis=-1)
+    assert t.shape == (3, 2)
+
+
+def test_npx_set_np_shape_semantics():
+    """npx.set_np flips the unknown-dim sentinel from 0 to -1 (reference:
+    mx.util.set_np / np_shape)."""
+    from mxnet_tpu.gluon import nn
+
+    npx.set_np()
+    try:
+        assert npx.is_np_array() and mx.util.is_np_shape()
+        # -1 marks deferred dims under np semantics
+        from mxnet_tpu.gluon.parameter import Parameter
+        p = Parameter("w", shape=(-1, 4), allow_deferred_init=True)
+        p.initialize()
+        p.shape = (3, 4)
+        assert p.shape == (3, 4)
+        assert p.data().shape == (3, 4)
+        # zero-dim scalars are real arrays
+        z = np.array(1.5)
+        assert z.shape == ()
+        assert float(z.asnumpy()) == 1.5
+    finally:
+        npx.reset_np()
+    # legacy: 0 marks deferred dims
+    from mxnet_tpu.gluon.parameter import Parameter
+    p = Parameter("w2", shape=(0, 4), allow_deferred_init=True)
+    p.initialize()
+    p.shape = (5, 4)
+    assert p.data().shape == (5, 4)

@@ -1,0 +1,54 @@
+"""Profiler aggregate stats + print_summary (reference:
+``tests/python/unittest/test_profiler.py`` ``test_aggregate_stats`` and
+``test_viz.py``)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def test_aggregate_stats_records_ops():
+    profiler.set_config(aggregate_stats=True)
+    try:
+        a = mx.nd.ones((64, 64))
+        for _ in range(3):
+            b = mx.nd.dot(a, a)
+        _ = b.asnumpy()
+        table = profiler.dumps(reset=True)
+    finally:
+        profiler.set_config(aggregate_stats=False)
+    assert "dot" in table
+    line = [l for l in table.splitlines() if l.strip().startswith("dot")][0]
+    fields = line.split()
+    assert int(fields[1]) >= 3  # count
+    assert float(fields[2]) > 0  # total ms
+    # reset=True cleared the table
+    assert "dot" not in profiler.dumps()
+
+
+def test_aggregate_stats_off_by_default():
+    a = mx.nd.ones((8, 8))
+    _ = (a + a).asnumpy()
+    assert "broadcast_add" not in profiler.dumps()
+
+
+def test_print_summary_real_params(capsys):
+    data = mx.sym.Variable("data")
+    w1 = mx.sym.Variable("fc1_weight")
+    b1 = mx.sym.Variable("fc1_bias")
+    fc1 = mx.sym.FullyConnected(data, w1, b1, num_hidden=64, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    w2 = mx.sym.Variable("fc2_weight")
+    b2 = mx.sym.Variable("fc2_bias")
+    out = mx.sym.FullyConnected(act, w2, b2, num_hidden=10, name="fc2")
+    total = mx.visualization.print_summary(out, shape={"data": (32, 128)})
+    captured = capsys.readouterr().out
+    # fc1: 128*64 + 64; fc2: 64*10 + 10
+    expected = 128 * 64 + 64 + 64 * 10 + 10
+    assert total == expected
+    assert f"Total params: {expected}" in captured
+    assert "32x64" in captured  # fc1 output shape
+    assert "32x10" in captured  # fc2 output shape
+    assert "-" not in [l.split()[1] for l in captured.splitlines()
+                       if l.startswith("fc")]  # no placeholder shapes
