@@ -20,6 +20,13 @@ from jax import lax
 from .registry import register
 
 
+def _rank_from_order(order):
+    """Invert a sort permutation: rank[i] = position of element i in order."""
+    n = order.shape[0]
+    return jnp.zeros((n,), jnp.int32).at[order].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
 def _corner_to_center(boxes):
     w = boxes[..., 2] - boxes[..., 0]
     h = boxes[..., 3] - boxes[..., 1]
@@ -82,18 +89,19 @@ def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
         cls_target = jnp.where(is_pos, cls, 0.0)
 
         if negative_mining_ratio > 0:
-            # rank negatives by max non-background confidence; keep the
-            # hardest ratio*num_pos, set the rest to ignore_label
+            # eligibility follows the reference (multibox_target.cc): an
+            # unmatched anchor is a candidate negative when its best gt IoU
+            # is BELOW negative_mining_thresh; ranking within the budget is
+            # by max non-background confidence (hardest negatives first)
             probs = jax.nn.softmax(cpred, axis=0)
             max_fg = jnp.max(probs[1:], axis=0)  # (N,)
-            neg = (~is_pos) & (max_fg > negative_mining_thresh)
+            neg = (~is_pos) & (best_gt_iou < negative_mining_thresh)
             num_pos = jnp.sum(is_pos)
             budget = jnp.maximum(
                 (negative_mining_ratio * num_pos).astype(jnp.int32),
                 minimum_negative_samples)
-            order = jnp.argsort(jnp.where(neg, -max_fg, jnp.inf))
-            rank = jnp.zeros((n,), jnp.int32).at[order].set(
-                jnp.arange(n, dtype=jnp.int32))
+            rank = _rank_from_order(jnp.argsort(jnp.where(neg, -max_fg,
+                                                          jnp.inf)))
             keep_neg = neg & (rank < budget)
             cls_target = jnp.where(is_pos, cls_target,
                                    jnp.where(keep_neg, 0.0, ignore_label))
@@ -128,10 +136,13 @@ def _decode_boxes(anchors, loc, variances, clip):
     return boxes
 
 
-def _nms_loop(boxes, scores, classes, iou_threshold, force_suppress):
-    """Greedy NMS on score-sorted boxes; returns keep mask (same order)."""
+def _nms_loop(boxes, scores, classes, iou_threshold, force_suppress,
+              order=None):
+    """Greedy NMS on score-sorted boxes; returns keep mask (same order).
+    ``order`` may pass a precomputed descending sort of ``scores``."""
     n = boxes.shape[0]
-    order = jnp.argsort(-scores)
+    if order is None:
+        order = jnp.argsort(-scores)
     b = boxes[order]
     c = classes[order]
     s = scores[order]
@@ -145,8 +156,7 @@ def _nms_loop(boxes, scores, classes, iou_threshold, force_suppress):
         return keep & ~row
 
     keep_sorted = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
-    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
-    return keep_sorted[inv], order
+    return keep_sorted[_rank_from_order(order)], order
 
 
 @register("MultiBoxDetection",
@@ -175,21 +185,26 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
         score = jnp.max(fg, axis=0)
         valid = score > threshold
         score_v = jnp.where(valid, score, -jnp.inf)
+        order0 = jnp.argsort(-score_v)
+        if nms_topk > 0:
+            # reference truncates to the top nms_topk score-sorted
+            # candidates BEFORE NMS (multibox_detection.cc), so boxes past
+            # that rank never participate in suppression. Masking to -inf
+            # keeps order0 a valid descending sort, so the sort is not
+            # recomputed inside _nms_loop.
+            rank = _rank_from_order(order0)
+            score_v = jnp.where(rank < nms_topk, score_v, -jnp.inf)
+            valid = valid & (rank < nms_topk)
         keep, order = _nms_loop(boxes, score_v, cls_id, nms_threshold,
-                                force_suppress)
+                                force_suppress, order=order0)
         ok = valid & keep
         rows = jnp.concatenate([
             jnp.where(ok, cls_id, -1.0)[:, None],
             jnp.where(ok, score, -1.0)[:, None],
             jnp.where(ok[:, None], boxes, -1.0),
         ], axis=1)
-        # reference returns rows sorted by score with invalid (-1) rows mixed
-        # at their original positions after nms_topk; we sort for stability
-        out = rows[order]
-        if nms_topk > 0:
-            mask = (jnp.arange(n) < nms_topk)[:, None]
-            out = jnp.where(mask, out, -1.0)
-        return out
+        # reference returns rows sorted by score; we sort for stability
+        return rows[order]
 
     return jax.vmap(one_batch)(cls_prob, loc_pred)
 
@@ -255,13 +270,23 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         keep, order = _nms_loop(top_boxes, top_scores,
                                 jnp.zeros((pre,), dtype), threshold, True)
         kept_scores = jnp.where(keep, top_scores, -jnp.inf)
-        sel_scores, sel = lax.top_k(kept_scores, rpn_post_nms_top_n)
+        # when the anchor grid is smaller than post_nms_top_n, top_k over
+        # the available `pre` and pad back up to the static output size
+        post = min(rpn_post_nms_top_n, pre)
+        sel_scores, sel = lax.top_k(kept_scores, post)
         out_boxes = top_boxes[sel]
         # pad slots with no surviving proposal by repeating the best box
         # (reference pads with index-0 samples), keeping shapes static
         ok = sel_scores > -jnp.inf
         out_boxes = jnp.where(ok[:, None], out_boxes, out_boxes[0])
-        return out_boxes, jnp.where(ok, sel_scores, 0.0)
+        out_scores = jnp.where(ok, sel_scores, 0.0)
+        if post < rpn_post_nms_top_n:
+            extra = rpn_post_nms_top_n - post
+            out_boxes = jnp.concatenate(
+                [out_boxes, jnp.broadcast_to(out_boxes[0], (extra, 4))], axis=0)
+            out_scores = jnp.concatenate(
+                [out_scores, jnp.zeros((extra,), out_scores.dtype)], axis=0)
+        return out_boxes, out_scores
 
     boxes, scores = jax.vmap(one_batch)(cls_prob, bbox_pred, im_info)
     batch_idx = jnp.repeat(jnp.arange(b, dtype=dtype), rpn_post_nms_top_n)

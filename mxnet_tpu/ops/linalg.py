@@ -89,12 +89,20 @@ def linalg_extracttrian(A, offset=0, lower=True):
 @register("linalg_maketrian", aliases=("_linalg_maketrian",))
 def linalg_maketrian(A, offset=0, lower=True):
     k = A.shape[-1]
-    # solve k = n(n+1)/2 - |offset| adjustments: reference restricts offset
-    # to 0 for the packed square case; general n from triangle size
-    n = 0
-    while (n * (n + 1)) // 2 + (abs(offset) * n) < k:
-        n += 1
-    n = n + abs(offset) if offset else n
+    d = abs(offset)
+    # a lower triangle with offset<0 (or upper with offset>0) SHRINKS: it
+    # packs m(m+1)/2 entries with m = n-d; the opposite sign GROWS the
+    # triangle to n(n+1)/2 + d*n - d(d+1)/2 entries. Solve n accordingly.
+    shrink = (offset < 0) if lower else (offset > 0)
+    if shrink:
+        n0 = 0
+        while n0 * (n0 + 1) // 2 < k:
+            n0 += 1
+        n = n0 + d
+    else:
+        n = 0
+        while n * (n + 1) // 2 + d * n - d * (d + 1) // 2 < k:
+            n += 1
     rows, cols = jnp.tril_indices(n, k=offset) if lower else \
         jnp.triu_indices(n, k=offset)
     out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)

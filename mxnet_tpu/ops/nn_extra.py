@@ -305,8 +305,9 @@ def correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
     p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
     d = max_displacement // stride2
     bound = max_displacement + kernel_size // 2
-    oh = (h + 2 * pad - 2 * bound) // stride1 or 1
-    ow = (w + 2 * pad - 2 * bound) // stride1 or 1
+    # reference (correlation.cc) uses ceil division for the output extent
+    oh = -(-(h + 2 * pad - 2 * bound) // stride1) or 1
+    ow = -(-(w + 2 * pad - 2 * bound) // stride1) or 1
     k = kernel_size
     outs = []
     ys = bound + jnp.arange(oh) * stride1
