@@ -31,3 +31,66 @@ def bulk(size):
 def sync_exec_enabled() -> bool:
     """NaiveEngine analog: MXTPU_SYNC_EXEC=1 -> block after every op."""
     return os.environ.get("MXTPU_SYNC_EXEC", "0") == "1"
+
+
+_RELAY = None  # lazily probed: does block_until_ready actually block?
+
+
+def _on_relay() -> bool:
+    """True when running behind a remote-execution relay (the ``axon``
+    PJRT plugin) whose ready-events resolve at *dispatch* time, so
+    ``jax.block_until_ready`` returns before the device computation
+    finishes. Measured on this relay: 0.2 ms from block_until_ready vs
+    6.9 s for a dependent host read of the same 40-matmul chain. The
+    only correct sync there is a dependent read."""
+    global _RELAY
+    if _RELAY is None:
+        force = os.environ.get("MXTPU_RELAY_SYNC")
+        if force is not None:
+            _RELAY = force == "1"
+        else:
+            try:
+                from jax._src import xla_bridge as xb
+
+                _RELAY = "axon" in xb.backends()
+            except Exception:
+                _RELAY = False
+    return _RELAY
+
+
+def wait(tree):
+    """THE sync primitive (reference: ``Engine::WaitForVar`` /
+    ``MXNDArrayWaitToRead``): block until every jax.Array leaf in
+    ``tree`` has finished computing, and surface any deferred device
+    error here.
+
+    On normal backends this is ``jax.block_until_ready``. On the axon
+    relay (see :func:`_on_relay`) it instead forces a dependent read of
+    ONE element per leaf — a device-side flatten+slice followed by a
+    1-element host transfer — which is the cheapest operation whose
+    completion implies the producing computation completed (~10 ms,
+    vs seconds for a full-array fetch at relay bandwidth).
+    """
+    import jax
+
+    if not _on_relay():
+        return jax.block_until_ready(tree)
+    import numpy as np
+    import jax.numpy as jnp
+
+    leaves = [leaf for leaf in jax.tree_util.tree_leaves(tree)
+              if isinstance(leaf, jax.Array)]
+    if not leaves:
+        return tree
+    try:
+        # one fused probe: per-leaf 1-element slices stacked on device and
+        # fetched in a single round-trip (a trip is ~60-110 ms on the
+        # relay, so one-per-leaf would make waitall O(live_arrays) trips)
+        probes = [(jnp.ravel(leaf)[:1] if leaf.ndim else leaf[None])
+                  .astype(jnp.float32) for leaf in leaves]
+        np.asarray(jnp.concatenate(probes))
+    except Exception:
+        # dtype not castable (or probe build failed): fall back per leaf
+        for leaf in leaves:
+            np.asarray(jnp.ravel(leaf)[:1] if leaf.ndim else leaf)
+    return tree

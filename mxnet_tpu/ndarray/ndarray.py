@@ -188,10 +188,14 @@ class NDArray:
         return int(self.asscalar())
 
     def wait_to_read(self):
-        jax.block_until_ready(self.data)
+        from .. import engine
+
+        engine.wait(self.data)
 
     def wait_to_write(self):
-        jax.block_until_ready(self.data)
+        from .. import engine
+
+        engine.wait(self.data)
 
     def __array__(self, dtype=None):
         a = self.asnumpy()
@@ -526,12 +530,21 @@ def waitall():
     Reference: ``MXNDArrayWaitAll`` — the global sync point where async
     engine exceptions surface (SURVEY.md §5.3).
     """
+    from .. import engine
+
+    live = [arr._data_ for arr in list(_LIVE)
+            if arr._base is None and arr._data_ is not None]
+    try:
+        # one batched sync (one relay round-trip for ALL live arrays)
+        engine.wait(live)
+        return
+    except Exception:
+        pass
     errs = []
-    for arr in list(_LIVE):
+    for data in live:  # re-sync per array to attribute the failure
         try:
-            if arr._base is None and arr._data_ is not None:
-                jax.block_until_ready(arr._data_)
-        except Exception as e:  # surface the first deferred error
+            engine.wait(data)
+        except Exception as e:
             errs.append(e)
     if errs:
         raise MXNetError(str(errs[0])) from errs[0]

@@ -23,9 +23,10 @@ from .registry import OpDef, jitted
 def _maybe_sync(res):
     """NaiveEngine analog (SURVEY §5.2): with MXTPU_SYNC_EXEC=1, block
     until the dispatched computation finishes so errors surface at the
-    faulting op instead of at the next sync point."""
+    faulting op instead of at the next sync point. Uses engine.wait,
+    which is relay-safe (block_until_ready does not block on axon)."""
     if engine.sync_exec_enabled():
-        jax.block_until_ready(res)
+        engine.wait(res)
     return res
 
 
@@ -41,7 +42,7 @@ def _run_timed(opdef, fn, raw):
 
     t0 = time.perf_counter()
     res = fn(*raw)
-    jax.block_until_ready(res)
+    engine.wait(res)
     profiler.record_op(opdef.name, time.perf_counter() - t0)
     return res
 

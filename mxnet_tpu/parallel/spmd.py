@@ -37,19 +37,32 @@ def replicate(arr, mesh):
     return jax.device_put(raw, NamedSharding(mesh, P()))
 
 
+def _state_dtype(w):
+    """Multi-precision rule (reference: ``mp_sgd_update``/``mp_adam_update``
+    in optimizer_op): low-precision weights carry f32 optimizer state and
+    update in f32 master math, casting back on write. This is also what
+    keeps the step's avals STABLE: without it, ``lr(f32) * m(bf16)``
+    promotes the new params to f32, every aval flips after step 1, and
+    jit recompiles the whole train step (observed: 2 extra 60s compiles
+    on BERT-base)."""
+    return jnp.float32 if w.dtype in (jnp.bfloat16, jnp.float16) else w.dtype
+
+
 def _sgd_rule(hyper):
     mom = hyper.get("momentum", 0.0)
     wd = hyper.get("wd", 0.0)
 
     def init(w):
-        return (jnp.zeros_like(w),) if mom else ()
+        return (jnp.zeros(w.shape, _state_dtype(w)),) if mom else ()
 
     def update(w, g, state, lr):
-        g = g + wd * w
+        dt = _state_dtype(w)
+        w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
+        g32 = g32 + wd * w32
         if mom:
-            m = mom * state[0] - lr * g
-            return w + m, (m,)
-        return w - lr * g, ()
+            m = mom * state[0] - lr32 * g32
+            return (w32 + m).astype(w.dtype), (m,)
+        return (w32 - lr32 * g32).astype(w.dtype), ()
 
     return init, update
 
@@ -61,17 +74,22 @@ def _adam_rule(hyper):
     wd = hyper.get("wd", 0.0)
 
     def init(w):
-        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+        dt = _state_dtype(w)
+        return (jnp.zeros(w.shape, dt), jnp.zeros(w.shape, dt),
+                jnp.zeros((), jnp.int32))
 
     def update(w, g, state, lr):
+        dt = _state_dtype(w)
         m, v, t = state
         t = t + 1
-        g = g + wd * w
-        m = beta1 * m + (1 - beta1) * g
-        v = beta2 * v + (1 - beta2) * jnp.square(g)
-        tf = t.astype(w.dtype)
-        lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
-        return w - lr_t * m / (jnp.sqrt(v) + eps), (m, v, t)
+        w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
+        g32 = g32 + wd * w32
+        m = beta1 * m + (1 - beta1) * g32
+        v = beta2 * v + (1 - beta2) * jnp.square(g32)
+        tf = t.astype(dt)
+        lr_t = lr32 * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+        return (w32 - lr_t * m / (jnp.sqrt(v) + eps)).astype(w.dtype), \
+            (m, v, t)
 
     return init, update
 
@@ -83,21 +101,25 @@ def _lamb_rule(hyper):
     wd = hyper.get("wd", 0.0)
 
     def init(w):
-        return (jnp.zeros_like(w), jnp.zeros_like(w), jnp.zeros((), jnp.int32))
+        dt = _state_dtype(w)
+        return (jnp.zeros(w.shape, dt), jnp.zeros(w.shape, dt),
+                jnp.zeros((), jnp.int32))
 
     def update(w, g, state, lr):
+        dt = _state_dtype(w)
         m, v, t = state
         t = t + 1
-        m = beta1 * m + (1 - beta1) * g
-        v = beta2 * v + (1 - beta2) * jnp.square(g)
-        tf = t.astype(w.dtype)
+        w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
+        m = beta1 * m + (1 - beta1) * g32
+        v = beta2 * v + (1 - beta2) * jnp.square(g32)
+        tf = t.astype(dt)
         m_hat = m / (1 - beta1 ** tf)
         v_hat = v / (1 - beta2 ** tf)
-        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w
-        w_norm = jnp.linalg.norm(w)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w32
+        w_norm = jnp.linalg.norm(w32)
         r_norm = jnp.linalg.norm(r)
         ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
-        return w - lr * ratio * r, (m, v, t)
+        return (w32 - lr32 * ratio * r).astype(w.dtype), (m, v, t)
 
     return init, update
 
@@ -108,14 +130,16 @@ def _nag_rule(hyper):
     wd = hyper.get("wd", 0.0)
 
     def init(w):
-        return (jnp.zeros_like(w),) if mom else ()
+        return (jnp.zeros(w.shape, _state_dtype(w)),) if mom else ()
 
     def update(w, g, state, lr):
-        g = g + wd * w
+        dt = _state_dtype(w)
+        w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
+        g32 = g32 + wd * w32
         if mom:
-            m = mom * state[0] + g
-            return w - lr * (g + mom * m), (m,)
-        return w - lr * g, ()
+            m = mom * state[0] + g32
+            return (w32 - lr32 * (g32 + mom * m)).astype(w.dtype), (m,)
+        return (w32 - lr32 * g32).astype(w.dtype), ()
 
     return init, update
 
@@ -151,6 +175,7 @@ class SPMDTrainStep:
         self._state = None  # (params, aux, opt_states) raw pytrees
         self._names = None
         self._diff = None
+        self._io_avals = None
 
     # -- state management -------------------------------------------------
     def _collect(self):
@@ -187,10 +212,18 @@ class SPMDTrainStep:
         params = []
         opt_states = []
         opt_specs = []
+        commit_dev = None
+        if self.mesh is None:
+            # commit to the default device: eager-built arrays are
+            # UNCOMMITTED while jit outputs are committed, and that
+            # sharding flip alone recompiles the step after call 1
+            commit_dev = jax.devices()[0]
         for n, h, d in zip(names, handles, diff):
             raw = h.data
             if self.mesh is not None:
                 raw = jax.device_put(raw, self._sharding_for(n, raw))
+            else:
+                raw = jax.device_put(raw, commit_dev)
             params.append(raw)
             if not d:
                 opt_states.append(())
@@ -207,6 +240,9 @@ class SPMDTrainStep:
                 state = tuple(
                     jax.device_put(leaf, NamedSharding(self.mesh, sp))
                     for leaf, sp in zip(state, leaf_specs))
+            else:
+                state = tuple(jax.device_put(leaf, commit_dev)
+                              for leaf in state)
             opt_states.append(state)
             opt_specs.append(leaf_specs)
         self._opt_specs = opt_specs
@@ -289,12 +325,38 @@ class SPMDTrainStep:
             self._compiled = self._build(None, None)
         key = _random._next_key()
         params, opt_states = self._state
+        lr_arr = jnp.asarray(lr, raw_x.dtype
+                             if raw_x.dtype in (jnp.float32, jnp.bfloat16)
+                             else jnp.float32)
+        # only the small call-arg avals are kept; param/state avals are
+        # rebuilt lazily from _state in cost_analysis() (keeps this hot
+        # path free of an O(n_params) tree_map per step)
+        self._io_avals = (raw_x.shape, raw_x.dtype, raw_y.shape, raw_y.dtype,
+                          lr_arr.dtype, key)
         new_params, new_states, loss = self._compiled(
-            params, opt_states, raw_x, raw_y, jnp.asarray(lr, raw_x.dtype
-                                                          if raw_x.dtype in (jnp.float32, jnp.bfloat16)
-                                                          else jnp.float32), key)
+            params, opt_states, raw_x, raw_y, lr_arr, key)
         self._state = (new_params, new_states)
         return float(loss) if sync else loss
+
+    def cost_analysis(self):
+        """XLA's cost analysis for the compiled step (``{"flops": ...}``),
+        or None when the backend doesn't expose it (some PJRT plugins).
+        NB: re-lowers and recompiles; on remote-compile backends this can
+        take as long as the first step."""
+        if self._compiled is None or self._io_avals is None:
+            return None
+        try:
+            xs, xd, ys, yd, lrd, key = self._io_avals
+            aval = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            avals = (jax.tree_util.tree_map(aval, self._state[0]),
+                     jax.tree_util.tree_map(aval, self._state[1]),
+                     jax.ShapeDtypeStruct(xs, xd),
+                     jax.ShapeDtypeStruct(ys, yd),
+                     jax.ShapeDtypeStruct((), lrd), aval(key))
+            cost = self._compiled.lower(*avals).compile().cost_analysis()
+            return cost[0] if isinstance(cost, (list, tuple)) else cost
+        except Exception:
+            return None
 
     def sync_to_block(self):
         """Write the step's param state back into the Gluon parameters."""

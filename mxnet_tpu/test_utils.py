@@ -201,3 +201,35 @@ class DummyIter:
 
     def reset(self):
         pass
+
+
+def chain_time_per_iter(step_fn, init, n1=5, n2=40, reps=3):
+    """Per-iteration wall time of ``step_fn`` (an ``x -> x``-shaped device
+    computation) via a two-point slope over dependent ``fori_loop`` chains.
+
+    This is the only sound micro-timing methodology on relay-tunneled
+    backends (axon): a single dispatch+sync round-trip costs 60-110 ms
+    and ``jax.block_until_ready`` does not block at all there (see
+    :func:`mxnet_tpu.engine.wait`), so single-shot timings measure the
+    network, not the device. Chaining n iterations inside ONE jit and
+    differencing two chain lengths cancels the round-trip exactly.
+    Used by bench.py and tests_tpu/.
+    """
+    import time
+
+    import jax
+    from jax import lax
+
+    from . import engine
+
+    def chain(n):
+        f = jax.jit(lambda s: lax.fori_loop(0, n, lambda i, s: step_fn(s), s))
+        engine.wait(f(init))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            engine.wait(f(init))
+            ts.append(time.perf_counter() - t0)
+        return min(ts)
+
+    return (chain(n2) - chain(n1)) / (n2 - n1)

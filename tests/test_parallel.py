@@ -195,17 +195,18 @@ def test_spmd_nag_matches_optimizer():
 
 def test_sync_exec_flag(monkeypatch):
     """MXTPU_SYNC_EXEC=1 -> every dispatch blocks (NaiveEngine analog)."""
+    import mxnet_tpu.engine as engine
     import mxnet_tpu.ops.dispatch as dispatch
 
     calls = []
-    real = jax.block_until_ready
+    real = engine.wait
 
     def spy(x):
         calls.append(1)
         return real(x)
 
     monkeypatch.setenv("MXTPU_SYNC_EXEC", "1")
-    monkeypatch.setattr(dispatch.jax, "block_until_ready", spy)
+    monkeypatch.setattr(dispatch.engine, "wait", spy)
     a = mx.nd.ones((2, 2))
     b = a + a
     assert_almost_equal(b, np.full((2, 2), 2.0, np.float32))

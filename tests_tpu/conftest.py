@@ -1,0 +1,41 @@
+"""TPU-only test suite: runs on the real chip (axon or direct PJRT).
+
+The main `tests/` suite forces XLA:CPU (reference test-strategy: CPU suite
+is the source of truth, SURVEY.md §4). This directory is the GPU-suite
+analog (`tests/python/gpu/`): it runs only where a TPU backend is live —
+`python -m pytest tests_tpu/ -q` in the bench environment — and skips
+itself entirely elsewhere.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    try:
+        on_tpu = jax.default_backend() not in ("cpu",)
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        skip = pytest.mark.skip(reason="no TPU backend live")
+        for item in items:
+            item.add_marker(skip)
+
+
+@pytest.fixture(autouse=True)
+def _seed_rngs():
+    import random
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+
+    np.random.seed(1234)
+    random.seed(1234)
+    mx.random.seed(1234)
+    yield

@@ -1,0 +1,117 @@
+"""Pallas flash-attention kernels vs the jnp oracle, ON the TPU.
+
+VERDICT r2 Missing #2: the flagship kernel was dead code on every verified
+path. These tests execute the real Pallas forward AND backward kernels on
+the chip and compare against `_jnp_flash_fwd` (the same math, plain jnp,
+differentiated by XLA) at several shapes and causal settings — including
+MULTI-BLOCK grids (T > block_size), which exercise the scratch init/finish
+logic, the dq dynamic-slice accumulation, and the causal block skip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu.ops import flash_attention as fa
+
+
+def _oracle_attention(q, k, v, scale, causal):
+    out, _ = fa._jnp_flash_fwd(q, k, v, scale, causal)
+    return out
+
+
+def _rand_qkv(B, H, T, S, D, dtype):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D), dtype)
+    k = jnp.asarray(rng.randn(B, H, S, D), dtype)
+    v = jnp.asarray(rng.randn(B, H, S, D), dtype)
+    return q, k, v
+
+
+SHAPES = [
+    # (B, H, T, S, D, causal, block_size) — several MULTI-block grids
+    (1, 2, 256, 256, 64, False, 512),    # single block (clamped)
+    (1, 2, 256, 256, 64, True, 512),
+    (2, 4, 512, 512, 128, True, 512),
+    (1, 2, 384, 384, 64, True, 128),     # 3 blocks (odd count)
+    (1, 1, 128, 512, 64, False, 128),    # cross-attention T != S, 4 kv blocks
+    (1, 2, 1024, 1024, 64, True, 512),   # 2x2 blocks at the default size
+    (1, 2, 1024, 1024, 64, False, 256),  # 4x4 blocks
+    (1, 1, 2048, 2048, 64, True, 512),   # 4x4 blocks, causal skip active
+]
+
+
+@pytest.mark.parametrize("B,H,T,S,D,causal,bs", SHAPES)
+def test_pallas_forward_matches_oracle(B, H, T, S, D, causal, bs):
+    q, k, v = _rand_qkv(B, H, T, S, D, jnp.float32)
+    scale = 1.0 / D ** 0.5
+    assert fa._pallas_ready(q, k, causal, bs)
+    got = fa.flash_attention(q, k, v, causal=causal, block_size=bs)
+    want = _oracle_attention(q, k, v, scale, causal)
+    # tolerance: MXU rounds f32 matmul inputs to bf16 at default precision,
+    # and kernel/oracle accumulate in different orders
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("B,H,T,S,D,causal,bs", SHAPES)
+def test_pallas_grads_match_oracle(B, H, T, S, D, causal, bs):
+    q, k, v = _rand_qkv(B, H, T, S, D, jnp.float32)
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, block_size=bs)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    def loss_oracle(q, k, v):
+        o = _oracle_attention(q, k, v, 1.0 / D ** 0.5, causal)
+        return jnp.sum(jnp.sin(o.astype(jnp.float32)))
+
+    g_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    g_oracle = jax.jit(jax.grad(loss_oracle, argnums=(0, 1, 2)))(q, k, v)
+    for gf, go, name in zip(g_flash, g_oracle, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(go, np.float32),
+            rtol=2e-2, atol=2e-2, err_msg=f"d{name} mismatch")
+
+
+def test_pallas_bf16_close_to_fp32_oracle():
+    B, H, T, D = 1, 2, 512, 64
+    q, k, v = _rand_qkv(B, H, T, T, D, jnp.bfloat16)
+    scale = 1.0 / D ** 0.5
+    got = fa.flash_attention(q, k, v, causal=True)
+    want = _oracle_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                             v.astype(jnp.float32), scale, True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_pallas_backward_wallclock_budget():
+    """Pallas bwd wall-clock vs fwd at T=4k.
+
+    The FA2 backward is 5 block-matmuls vs the forward's 2, so the FLOP
+    floor for bwd-only is 2.5x fwd; the fused kernel should sit near it
+    (grad total = fwd recompute + bwd <= 3.5x fwd, with slack).
+    Timing via test_utils.chain_time_per_iter (single-shot timing is
+    meaningless behind the relay).
+    """
+    from mxnet_tpu.test_utils import chain_time_per_iter
+
+    B, H, T, D = 2, 8, 4096, 64
+    q, k, v = _rand_qkv(B, H, T, T, D, jnp.bfloat16)
+    assert fa._pallas_ready(q, k, True, 512)
+
+    fwd_step = lambda x: fa.flash_attention(x, k, v, causal=True) \
+        .astype(x.dtype)
+
+    def gstep(x):
+        def loss(xq):
+            return jnp.sum(fa.flash_attention(xq, k, v, causal=True)
+                           .astype(jnp.float32))
+        return jax.grad(loss)(x).astype(x.dtype)
+
+    t_fwd = chain_time_per_iter(fwd_step, q, 25, 200)
+    t_grad = chain_time_per_iter(gstep, q, 25, 100)
+    assert t_grad <= 3.5 * t_fwd + 0.002, (t_fwd, t_grad)
