@@ -551,20 +551,38 @@ def waitall():
 
 
 def save(fname, data):
-    """Save NDArrays (reference format analog: ``NDArray::Save`` NDARRAY_V2).
-
-    TPU-native: a single ``.npz`` container; keys preserved for dict input.
-    """
+    """Save NDArrays in the reference binary format (``NDArray::Save``,
+    magic ``NDARRAY_V2`` inside the 0x112 list container) — the declared
+    compatibility boundary: files interchange with reference MXNet's
+    ``mx.nd.save``. Sparse arrays fall back to the ``.npz`` container
+    (binary sparse blobs are a documented drop; ``load`` sniffs both)."""
     import numpy as np
 
+    from . import serialization
+
     if isinstance(data, NDArray):
-        payload = {"__mxtpu_list_0": data.asnumpy()}
+        arrays, names = [data], []
     elif isinstance(data, (list, tuple)):
-        payload = {f"__mxtpu_list_{i}": d.asnumpy() for i, d in enumerate(data)}
+        arrays, names = list(data), []
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
     else:
         raise TypeError(f"cannot save type {type(data)}")
+    if all(type(a) is NDArray for a in arrays):
+        raws = [a.asnumpy() for a in arrays]
+        try:  # every dtype must be expressible as an NDARRAY_V2 flag
+            for r in raws:
+                serialization._flag_from_np(r.dtype)
+            serializable = True
+        except MXNetError:
+            serializable = False  # e.g. bool masks -> npz fallback below
+        if serializable:
+            serialization.save_params(fname, raws, names)
+            return
+    payload = ({f"__mxtpu_list_{i}": d.asnumpy() for i, d in enumerate(arrays)}
+               if not names else
+               {k: v.asnumpy() for k, v in zip(names, arrays)})
     with open(fname, "wb") as f:  # exact fname (np.savez would append .npz)
         np.savez(f, **payload)
 
@@ -572,6 +590,13 @@ def save(fname, data):
 def load(fname):
     import numpy as np
 
+    from . import serialization
+
+    if serialization.sniff_format(fname) == "ndarray_v2":
+        arrays, names = serialization.load_params(fname)
+        if names:
+            return {n: array(a) for n, a in zip(names, arrays)}
+        return [array(a) for a in arrays]
     with np.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         if keys and all(k.startswith("__mxtpu_list_") for k in keys):

@@ -1,0 +1,177 @@
+"""Reference binary ``.params`` serialization (NDARRAY_V2).
+
+Byte-compatible implementation of the MXNet 1.x NDArray file container
+(reference: ``src/ndarray/ndarray.cc`` ``NDArray::Save/Load`` and the
+``MXNDArraySave`` list container in ``src/c_api/c_api.cc``; SURVEY.md
+§5.4). This is one of the three declared compatibility boundaries
+(``docs/design_decisions.md``): a ``.params`` file written by reference
+MXNet loads here and vice versa.
+
+Layout (little-endian throughout; dmlc::Stream conventions):
+
+  file container (NDArray::Save(fo, data, names)):
+    uint64  kMXAPINDArrayListMagic = 0x112
+    uint64  reserved = 0
+    uint64  count                  -- dmlc vector<NDArray> serializer
+    NDArray blobs x count
+    uint64  name_count             -- dmlc vector<string> serializer
+    { uint64 len; bytes } x name_count
+
+  dense NDArray blob (save_v2):
+    uint32  NDARRAY_V2_MAGIC = 0xF993FAC9
+    int32   storage type           -- kDefaultStorage = 0
+    uint32  ndim                   -- mshadow TShape::Save (uint32 index_t
+    uint32  dims[ndim]                builds; INT64_TENSOR_SIZE builds are
+                                      not supported -- documented)
+    int32   dev_type; int32 dev_id -- Context::Save (we write cpu(0))
+    int32   type_flag              -- mshadow dtype enum
+    bytes   raw data (C order)
+
+Legacy V1 blobs (magic 0xF993FAC8: no storage-type field) are accepted on
+read. Sparse (row_sparse/csr) blobs raise: the zoo/.params use case is
+dense; sparse interchange stays on the npz path.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from ..base import MXNetError
+
+MAGIC_LIST = 0x112
+NDARRAY_V1_MAGIC = 0xF993FAC8
+NDARRAY_V2_MAGIC = 0xF993FAC9
+NDARRAY_V3_MAGIC = 0xF993FACA
+
+# mshadow type_flag enum (mshadow/base.h); 12 = bfloat16 (1.8+ oneDNN)
+_TYPE_FLAG_TO_NP = {
+    0: np.float32, 1: np.float64, 2: np.float16, 3: np.uint8,
+    4: np.int32, 5: np.int8, 6: np.int64,
+}
+_NP_TO_TYPE_FLAG = {np.dtype(v): k for k, v in _TYPE_FLAG_TO_NP.items()}
+_BF16_FLAG = 12
+
+
+def _np_from_flag(flag):
+    if flag == _BF16_FLAG:
+        try:
+            import ml_dtypes
+
+            return np.dtype(ml_dtypes.bfloat16)
+        except ImportError:  # pragma: no cover
+            raise MXNetError("bfloat16 .params needs ml_dtypes")
+    try:
+        return np.dtype(_TYPE_FLAG_TO_NP[flag])
+    except KeyError:
+        raise MXNetError(f"unsupported dtype flag {flag} in NDArray blob")
+
+
+def _flag_from_np(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.name == "bfloat16":
+        return _BF16_FLAG
+    try:
+        return _NP_TO_TYPE_FLAG[dtype]
+    except KeyError:
+        raise MXNetError(f"cannot save dtype {dtype} to NDARRAY_V2")
+
+
+def _write_blob(f, arr):
+    arr = np.ascontiguousarray(arr)
+    f.write(struct.pack("<I", NDARRAY_V2_MAGIC))
+    f.write(struct.pack("<i", 0))  # kDefaultStorage
+    f.write(struct.pack("<I", arr.ndim))
+    f.write(struct.pack(f"<{arr.ndim}I", *arr.shape))
+    f.write(struct.pack("<ii", 1, 0))  # Context: cpu(=1 in DeviceType), id 0
+    f.write(struct.pack("<i", _flag_from_np(arr.dtype)))
+    f.write(arr.tobytes())
+
+
+def _read_exact(f, n):
+    b = f.read(n)
+    if len(b) != n:
+        raise MXNetError("truncated NDArray blob")
+    return b
+
+
+def _read_blob(f):
+    (magic,) = struct.unpack("<I", _read_exact(f, 4))
+    if magic == NDARRAY_V2_MAGIC or magic == NDARRAY_V3_MAGIC:
+        (stype,) = struct.unpack("<i", _read_exact(f, 4))
+        if stype not in (0, -1):  # kDefaultStorage / kUndefined
+            raise MXNetError(
+                f"sparse NDArray blobs (stype {stype}) are not supported by "
+                "the binary .params reader; use the npz path for sparse")
+    elif magic == NDARRAY_V1_MAGIC:
+        pass  # V1: no storage-type field
+    else:
+        raise MXNetError(f"not an NDArray blob (magic {magic:#x})")
+    dim_fmt = "<q" if magic == NDARRAY_V3_MAGIC else "<I"
+    dim_sz = 8 if magic == NDARRAY_V3_MAGIC else 4
+    (ndim,) = struct.unpack("<I", _read_exact(f, 4))
+    if ndim > 32:
+        raise MXNetError(f"implausible ndim {ndim} in NDArray blob")
+    shape = tuple(
+        struct.unpack(dim_fmt, _read_exact(f, dim_sz))[0] for _ in range(ndim))
+    struct.unpack("<ii", _read_exact(f, 8))  # context, ignored
+    (flag,) = struct.unpack("<i", _read_exact(f, 4))
+    dtype = _np_from_flag(flag)
+    count = 1
+    for s in shape:
+        count *= s
+    data = _read_exact(f, count * dtype.itemsize)
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+def save_params(fname, arrays, names):
+    """Write the reference list container. ``names`` may be empty (the
+    reference writes positional lists that way). Writes via a temp file +
+    rename so a failed save never leaves a truncated container behind."""
+    import os
+
+    tmp = f"{fname}.tmp{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(struct.pack("<QQ", MAGIC_LIST, 0))
+            f.write(struct.pack("<Q", len(arrays)))
+            for a in arrays:
+                _write_blob(f, a)
+            f.write(struct.pack("<Q", len(names)))
+            for n in names:
+                nb = n.encode("utf-8")
+                f.write(struct.pack("<Q", len(nb)))
+                f.write(nb)
+        os.replace(tmp, fname)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def load_params(fname):
+    """Read the reference list container -> (list_of_np, list_of_names)."""
+    with open(fname, "rb") as f:
+        magic, _ = struct.unpack("<QQ", _read_exact(f, 16))
+        if magic != MAGIC_LIST:
+            raise MXNetError(
+                f"not an MXNet .params file (magic {magic:#x}, want 0x112)")
+        (count,) = struct.unpack("<Q", _read_exact(f, 8))
+        arrays = [_read_blob(f) for _ in range(count)]
+        (ncount,) = struct.unpack("<Q", _read_exact(f, 8))
+        names = []
+        for _ in range(ncount):
+            (ln,) = struct.unpack("<Q", _read_exact(f, 8))
+            names.append(_read_exact(f, ln).decode("utf-8"))
+    return arrays, names
+
+
+def sniff_format(fname):
+    """'ndarray_v2' | 'npz' | 'unknown' by magic bytes."""
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if len(head) == 8 and struct.unpack("<Q", head)[0] == MAGIC_LIST:
+        return "ndarray_v2"
+    if head[:2] == b"PK":  # zip container (np.savez)
+        return "npz"
+    return "unknown"

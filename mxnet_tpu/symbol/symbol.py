@@ -254,7 +254,12 @@ class Symbol:
 
     # -- save/load --------------------------------------------------------
     def tojson(self):
-        """Serialize (format: same node-list idea as nnvm SaveJSON)."""
+        """Serialize in the nnvm ``SaveJSON`` schema (reference:
+        ``3rdparty/tvm/nnvm/src/core/graph.cc`` / ``MXSymbolSaveToJSON``):
+        ``nodes`` (attrs stringified the MXNet way), ``arg_nodes`` (indices
+        of variable nodes), ``node_row_ptr`` (cumulative output counts),
+        ``heads``. Files interchange with reference ``sym.save`` /
+        ``SymbolBlock.imports``."""
         nodes = []
         node_ids = {}
         for node in self._topo():
@@ -262,20 +267,30 @@ class Symbol:
                 continue
             node_ids[id(node)] = len(nodes)
             nodes.append(node)
+        json_nodes = []
+        arg_nodes = []
+        node_row_ptr = [0]
+        for n in nodes:
+            entry = {
+                "op": n._op or "null",
+                "name": n._name,
+                "inputs": [[node_ids[id(i)], i._index, 0] for i in n._inputs],
+            }
+            if n._attrs:
+                entry["attrs"] = {k: _attr_str(k, v)
+                                  for k, v in n._attrs.items()}
+            if n._op is None:
+                arg_nodes.append(node_ids[id(n)])
+            json_nodes.append(entry)
+            node_row_ptr.append(node_row_ptr[-1] + n._num_outputs)
         blob = {
-            "nodes": [
-                {
-                    "op": n._op or "null",
-                    "name": n._name,
-                    "attrs": {k: _json_attr(v) for k, v in n._attrs.items()},
-                    "inputs": [[node_ids[id(i)], i._index, 0] for i in n._inputs],
-                }
-                for n in nodes
-            ],
+            "nodes": json_nodes,
+            "arg_nodes": arg_nodes,
+            "node_row_ptr": node_row_ptr,
             "heads": [[node_ids[id(self)], self._index, 0]]
             if self._op != "_group"
             else [[node_ids[id(s)], s._index, 0] for s in self._inputs],
-            "mxtpu_version": 1,
+            "attrs": {"mxnet_version": ["int", 10700]},
         }
         return json.dumps(blob, indent=2)
 
@@ -333,10 +348,36 @@ class Symbol:
         return _sym_op.reshape(self, shape=tuple(shape))
 
 
-def _json_attr(v):
-    if isinstance(v, tuple):
-        return list(v)
-    return v
+def _attr_str(key, v):
+    """Stringify an attr the MXNet JSON way: every value is a string —
+    tuples print as ``(3, 3)``, bools as ``True``, numbers via str().
+    ``__dtype__`` is the one key with special encoding: the reference
+    writes the mshadow integer type flag ('0' for float32), not the
+    numpy name, and its loaders int()-parse it."""
+    if key == "__dtype__" and isinstance(v, str):
+        flags = {n: f for f, n in _DTYPE_FLAG_NAMES.items()}
+        if v in flags:
+            return str(flags[v])
+    return str(v)
+
+
+def _attr_parse(v):
+    """Parse a JSON attr back to a typed value: nnvm-schema files carry
+    strings ('(3, 3)', '64', 'True', 'relu'); legacy mxtpu files carry
+    typed JSON (lists for tuples)."""
+    if isinstance(v, list):
+        return tuple(v)
+    if not isinstance(v, str):
+        return v
+    try:
+        import ast
+
+        parsed = ast.literal_eval(v)
+        if isinstance(parsed, list):
+            return tuple(parsed)
+        return parsed
+    except (ValueError, SyntaxError):
+        return v  # plain string attr ('relu', 'valid', ...)
 
 
 def _scalar_sym(value):
@@ -368,11 +409,19 @@ def load(fname):
 
 
 def load_json(json_str):
+    """Load either schema: nnvm ``SaveJSON`` (reference ``sym.load`` files;
+    stringified attrs, ``arg_nodes``/``node_row_ptr`` ignored on load the
+    way nnvm's own loader does) or the legacy mxtpu_version=1 typed form."""
     blob = json.loads(json_str)
     nodes = []
     for n in blob["nodes"]:
-        attrs = {k: (tuple(v) if isinstance(v, list) else v)
-                 for k, v in n.get("attrs", {}).items()}
+        # pre-1.6 reference files use "attr"/"param" instead of "attrs"
+        raw_attrs = n.get("attrs") or n.get("attr") or n.get("param") or {}
+        attrs = {k: _attr_parse(v) for k, v in raw_attrs.items()}
+        # reference variable nodes carry __dtype__ as a mshadow type flag
+        if isinstance(attrs.get("__dtype__"), int):
+            attrs["__dtype__"] = _DTYPE_FLAG_NAMES.get(
+                attrs["__dtype__"], "float32")
         if n["op"] == "null":
             sym = Symbol(None, attrs, [], name=n["name"])
         else:
@@ -385,6 +434,10 @@ def load_json(json_str):
     heads = [nodes[i][idx] if nodes[i]._num_outputs > 1 else nodes[i]
              for i, idx, _ in blob["heads"]]
     return heads[0] if len(heads) == 1 else Group(heads)
+
+
+_DTYPE_FLAG_NAMES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                     4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
 
 
 def _num_outputs_of(op, attrs):
