@@ -48,12 +48,19 @@ def _peak_tflops():
     return None
 
 
+_EMIT_BUFFER = None  # non-None => buffer records instead of printing
+
+
 def _emit(metric, value, unit, vs_baseline=None, **extra):
     rec = {"metric": metric, "value": round(value, 2), "unit": unit,
            "vs_baseline": round(vs_baseline, 4) if vs_baseline else 1.0}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in extra.items()})
-    print(json.dumps(rec), flush=True)
+    line = json.dumps(rec)
+    if _EMIT_BUFFER is not None:
+        _EMIT_BUFFER.append(line)
+    else:
+        print(line, flush=True)
 
 
 def bench_resnet(backend):
@@ -234,7 +241,7 @@ def bench_allreduce(backend):
 
     # fused in-graph psum path (what training uses)
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     mesh = Mesh(np.array(jax.devices()), ("dp",))
     x = jax.device_put(jnp.ones((max(ndev, 1), n_elem // max(ndev, 1)),
@@ -292,18 +299,27 @@ def main():
              ("flash_attention", bench_flash_attention),
              ("bert", bench_bert),
              ("resnet", bench_resnet)]  # resnet LAST: tail = headline
+    global _EMIT_BUFFER
     for name, fn in suite:
         if only and name not in only:
             continue
         for attempt in (1, 2):  # the relay's remote-compile service
-            try:                # intermittently drops connections
+            _EMIT_BUFFER = []   # intermittently drops connections; buffer
+            try:                # so a retried section never double-emits
                 fn(backend)
+                for line in _EMIT_BUFFER:
+                    print(line, flush=True)
                 break
             except Exception as e:  # never lose the remaining metrics
+                print(f"# {name} attempt {attempt} failed: "
+                      f"{type(e).__name__}: {e}"[:300], file=sys.stderr,
+                      flush=True)
                 if attempt == 2:
                     print(json.dumps({"metric": f"{name}_FAILED",
                                       "error": f"{type(e).__name__}: {e}"[:300]}),
                           flush=True)
+            finally:
+                _EMIT_BUFFER = None
 
 
 if __name__ == "__main__":

@@ -11,6 +11,7 @@ upload (PCIe->HBM) is the same single hop.
 from __future__ import annotations
 
 import multiprocessing
+import os
 import pickle
 
 import numpy as _np
@@ -160,7 +161,18 @@ class DataLoader:
                                                initializer=_worker_initializer,
                                                initargs=(self._dataset,))
             else:
-                ctx = multiprocessing.get_context("fork")
+                # forkserver, not fork: the parent holds live JAX/XLA
+                # threads by the time a DataLoader is built, and forking a
+                # multithreaded process deadlocks (the reference used a
+                # dedicated shared-memory worker protocol for the same
+                # reason, SURVEY.md §2.4 DataLoader). The forkserver
+                # process is exec'd fresh and single-threaded; workers
+                # fork from IT. NB (as with torch DataLoader): non-fork
+                # start methods import __main__, so user scripts that
+                # build a num_workers>0 DataLoader at module top level
+                # need an ``if __name__ == "__main__"`` guard.
+                method = "forkserver" if hasattr(os, "fork") else "spawn"
+                ctx = multiprocessing.get_context(method)
                 self._worker_pool = ctx.Pool(
                     self._num_workers, initializer=_worker_initializer,
                     initargs=(self._dataset,))

@@ -30,6 +30,8 @@ from .mobilenet import (  # noqa: F401
 )
 from .inception import Inception3, inception_v3  # noqa: F401
 from .ssd import SSD, SSDLoss, ssd_tiny, ssd_300  # noqa: F401
+from .faster_rcnn import (FasterRCNN, FasterRCNNLoss,  # noqa: F401
+                          faster_rcnn_tiny)
 
 _models = {
     "resnet18_v1": resnet18_v1,
@@ -56,6 +58,7 @@ _models = {
     "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
     "inceptionv3": inception_v3,
     "ssd_tiny": ssd_tiny,
+    "faster_rcnn_tiny": faster_rcnn_tiny,
     "ssd_300": ssd_300,
 }
 

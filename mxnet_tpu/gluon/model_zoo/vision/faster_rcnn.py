@@ -1,0 +1,274 @@
+"""Faster-RCNN (mini): the two-stage detection pipeline in the zoo.
+
+Reference anchors: the contrib ops this composes — ``Proposal``
+(``src/operator/contrib/proposal.cc``) and ``ROIAlign``
+(``src/operator/contrib/roi_align.cc``) — plus the rcnn example's target
+assigners (``example/rcnn``: anchor/proposal target layers). BASELINE
+config #2 names Faster-RCNN as the second detection architecture.
+
+TPU-native shape discipline: every stage is static-shape. Proposal pads
+to ``rpn_post_nms_top_n`` rows; during training the last ``M`` roi slots
+per image are overwritten with the ground-truth boxes (the standard
+"append gt" trick, made static by replacement instead of concat) so the
+RCNN head always sees positives; target assignment masks padded/ignored
+entries instead of filtering them.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...block import HybridBlock
+from ...nn import Conv2D, Dense, HybridSequential
+from ....ops import detection as _det
+
+
+class FasterRCNN(HybridBlock):
+    """Backbone -> RPN -> Proposal -> ROIAlign -> cls/bbox heads.
+
+    ``hybrid_forward(x, im_info)`` (inference) returns
+    ``(rpn_cls, rpn_bbox, rois, cls_scores, bbox_pred)``;
+    pass ``gt_boxes`` (B, M, 5) ``[cls, x1, y1, x2, y2]`` (pixel coords,
+    cls in [0, classes)) to plant them into the roi set for training.
+    """
+
+    def __init__(self, classes=3, base_channels=(16, 32, 64),
+                 rpn_channels=64, scales=(1, 2, 4), ratios=(0.5, 1, 2),
+                 rpn_pre_nms=256, rpn_post_nms=32, roi_size=(7, 7),
+                 top_units=128, **kwargs):
+        super().__init__(**kwargs)
+        self.classes = classes
+        self.scales = tuple(scales)
+        self.ratios = tuple(ratios)
+        self.num_anchors = len(scales) * len(ratios)
+        self.feature_stride = 2 ** len(base_channels)
+        self.rpn_pre_nms = rpn_pre_nms
+        self.rpn_post_nms = rpn_post_nms
+        self.roi_size = tuple(roi_size)
+        with self.name_scope():
+            self.stem = HybridSequential(prefix="stem_")
+            for c in base_channels:
+                self.stem.add(Conv2D(c, 3, padding=1, strides=2,
+                                     activation="relu"))
+            A = self.num_anchors
+            self.rpn_conv = Conv2D(rpn_channels, 3, padding=1,
+                                   activation="relu", prefix="rpn_conv_")
+            self.rpn_cls = Conv2D(2 * A, 1, prefix="rpn_cls_")
+            self.rpn_bbox = Conv2D(4 * A, 1, prefix="rpn_bbox_")
+            self.top = HybridSequential(prefix="top_")
+            self.top.add(Dense(top_units, activation="relu"),
+                         Dense(top_units, activation="relu"))
+            self.cls_score = Dense(classes + 1, prefix="cls_score_")
+            self.bbox_pred = Dense((classes + 1) * 4, prefix="bbox_pred_")
+
+    def hybrid_forward(self, F, x, im_info, gt_boxes=None):
+        B = x.shape[0]
+        A = self.num_anchors
+        feat = self.stem(x)
+        r = self.rpn_conv(feat)
+        rpn_cls = self.rpn_cls(r)          # (B, 2A, H, W)
+        rpn_bbox = self.rpn_bbox(r)        # (B, 4A, H, W)
+        H, W = rpn_cls.shape[2], rpn_cls.shape[3]
+        # pairwise softmax over {bg, fg} per anchor (reference layout:
+        # channels [0:A] = bg, [A:2A] = fg)
+        prob = F.reshape(rpn_cls, (B, 2, A * H * W))
+        prob = F.softmax(prob, axis=1)
+        prob = F.reshape(prob, (B, 2 * A, H, W))
+        rois = F.Proposal(prob, rpn_bbox, im_info,
+                          rpn_pre_nms_top_n=self.rpn_pre_nms,
+                          rpn_post_nms_top_n=self.rpn_post_nms,
+                          feature_stride=self.feature_stride,
+                          scales=self.scales, ratios=self.ratios)
+        rois = F.stop_gradient(rois)       # proposals are constants
+        if gt_boxes is not None:
+            # overwrite the LAST M roi slots per image with gt boxes
+            # (static-shape "append gt": guarantees RCNN positives)
+            M = gt_boxes.shape[1]
+            rois3 = F.reshape(rois, (B, self.rpn_post_nms, 5))
+            keep = F.slice_axis(rois3, axis=1, begin=0,
+                                end=self.rpn_post_nms - M)
+            batch_idx = F.broadcast_to(
+                F.reshape(F.arange(0, B), (B, 1, 1)), (B, M, 1))
+            gt_rois = F.concat(batch_idx,
+                               F.slice_axis(gt_boxes, axis=2, begin=1, end=5),
+                               dim=2)
+            rois3 = F.concat(keep, F.stop_gradient(gt_rois), dim=1)
+            rois = F.reshape(rois3, (B * self.rpn_post_nms, 5))
+        pooled = F.ROIAlign(feat, rois, pooled_size=self.roi_size,
+                            spatial_scale=1.0 / self.feature_stride,
+                            sample_ratio=2)
+        flat = F.reshape(pooled, (pooled.shape[0], -1))
+        top = self.top(flat)
+        cls_scores = self.cls_score(top)       # (B*R, C+1)
+        bbox_pred = self.bbox_pred(top)        # (B*R, (C+1)*4)
+        return rpn_cls, rpn_bbox, rois, cls_scores, bbox_pred
+
+    # -- inference decode (eager helper; reference: rcnn PredictorOp) ------
+    def detect(self, x, im_info, score_thresh=0.05, nms_thresh=0.3):
+        """Full two-stage inference -> (B, R, 6) [cls, score, x1 y1 x2 y2]
+        rows, suppressed entries -1 (box_nms conventions)."""
+        from ....ndarray import op as ndop
+        from ....ndarray.ndarray import NDArray
+
+        _, _, rois, cls_scores, bbox_pred = self(x, im_info)
+        B = x.shape[0]
+        R = self.rpn_post_nms
+        probs = ndop.softmax(cls_scores, axis=-1)        # (B*R, C+1)
+        cls = ndop.argmax(ndop.slice_axis(probs, axis=1, begin=1,
+                                          end=self.classes + 1), axis=1) + 1
+        score = ndop.max(ndop.slice_axis(probs, axis=1, begin=1,
+                                         end=self.classes + 1), axis=1)
+        # decode the predicted class's deltas against its roi
+        raw_rois = rois.data if isinstance(rois, NDArray) else rois
+        raw_cls = cls.data.astype(jnp.int32)
+        raw_deltas = bbox_pred.data.reshape(-1, self.classes + 1, 4)
+        deltas = jnp.take_along_axis(
+            raw_deltas, raw_cls[:, None, None].repeat(4, -1), axis=1)[:, 0]
+        boxes = _decode_deltas(raw_rois[:, 1:5], deltas)
+        h = im_info.data[0, 0] if hasattr(im_info, "data") else im_info[0, 0]
+        w = im_info.data[0, 1] if hasattr(im_info, "data") else im_info[0, 1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, w - 1),
+                           jnp.clip(boxes[:, 1], 0, h - 1),
+                           jnp.clip(boxes[:, 2], 0, w - 1),
+                           jnp.clip(boxes[:, 3], 0, h - 1)], axis=-1)
+        det = jnp.concatenate([raw_cls[:, None].astype(boxes.dtype),
+                               score.data[:, None], boxes], axis=1)
+        det = det.reshape(B, R, 6)
+        out = ndop.box_nms(NDArray(det), overlap_thresh=nms_thresh,
+                           valid_thresh=score_thresh, coord_start=2,
+                           score_index=1, id_index=0, force_suppress=False)
+        return out
+
+
+def _decode_deltas(rois_xyxy, deltas):
+    """Inverse of the RCNN bbox encoding (reference bbox_transform_inv)."""
+    w = rois_xyxy[:, 2] - rois_xyxy[:, 0] + 1.0
+    h = rois_xyxy[:, 3] - rois_xyxy[:, 1] + 1.0
+    cx = rois_xyxy[:, 0] + 0.5 * w
+    cy = rois_xyxy[:, 1] + 0.5 * h
+    pcx = deltas[:, 0] * w + cx
+    pcy = deltas[:, 1] * h + cy
+    pw = jnp.exp(jnp.clip(deltas[:, 2], -10, 10)) * w
+    ph = jnp.exp(jnp.clip(deltas[:, 3], -10, 10)) * h
+    return jnp.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                      pcx + 0.5 * pw - 1.0, pcy + 0.5 * ph - 1.0], axis=-1)
+
+
+def _encode_deltas(rois_xyxy, gt_xyxy):
+    w = rois_xyxy[:, 2] - rois_xyxy[:, 0] + 1.0
+    h = rois_xyxy[:, 3] - rois_xyxy[:, 1] + 1.0
+    cx = rois_xyxy[:, 0] + 0.5 * w
+    cy = rois_xyxy[:, 1] + 0.5 * h
+    gw = gt_xyxy[:, 2] - gt_xyxy[:, 0] + 1.0
+    gh = gt_xyxy[:, 3] - gt_xyxy[:, 1] + 1.0
+    gcx = gt_xyxy[:, 0] + 0.5 * gw
+    gcy = gt_xyxy[:, 1] + 0.5 * gh
+    return jnp.stack([(gcx - cx) / w, (gcy - cy) / h,
+                      jnp.log(gw / w), jnp.log(gh / h)], axis=-1)
+
+
+class FasterRCNNLoss:
+    """Four-term objective: RPN objectness CE + RPN bbox smooth-L1 +
+    RCNN class CE + RCNN per-class bbox smooth-L1 (reference:
+    rcnn example's anchor/proposal target layers + module losses).
+    Targets are assigned eagerly (no tape) from detached rois/anchors."""
+
+    def __init__(self, net, rpn_pos_iou=0.7, rpn_neg_iou=0.3,
+                 rcnn_fg_iou=0.5):
+        self._net = net
+        self._rpn_pos = rpn_pos_iou
+        self._rpn_neg = rpn_neg_iou
+        self._fg = rcnn_fg_iou
+
+    def _rpn_targets(self, anchors, gt):  # anchors (N,4), gt (M,5)
+        iou = _det._iou_matrix(anchors, gt[:, 1:5])      # (N, M)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        labels = jnp.full((anchors.shape[0],), -1.0)
+        labels = jnp.where(best < self._rpn_neg, 0.0, labels)
+        labels = jnp.where(best >= self._rpn_pos, 1.0, labels)
+        # the best anchor per gt is always positive
+        best_anchor = jnp.argmax(iou, axis=0)            # (M,)
+        labels = labels.at[best_anchor].set(1.0)
+        deltas = _encode_deltas(anchors, gt[best_gt, 1:5])
+        return labels, deltas
+
+    def _rcnn_targets(self, rois, gt):  # rois (R,5), gt (M,5)
+        iou = _det._iou_matrix(rois[:, 1:5], gt[:, 1:5])
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        cls = jnp.where(best >= self._fg, gt[best_gt, 0] + 1.0, 0.0)
+        deltas = _encode_deltas(rois[:, 1:5], gt[best_gt, 1:5])
+        return cls, deltas
+
+    def __call__(self, out, gt_boxes):
+        from ....ndarray import op as ndop
+        from ....ndarray.ndarray import NDArray
+
+        rpn_cls, rpn_bbox, rois, cls_scores, bbox_pred = out
+        net = self._net
+        A = net.num_anchors
+        B, _, H, W = rpn_cls.shape
+        gt_raw = gt_boxes.data if isinstance(gt_boxes, NDArray) else gt_boxes
+        anchors = _det._make_grid_anchors(
+            H, W, net.feature_stride, net.scales, net.ratios,
+            jnp.float32)                                  # (HWA, 4)
+
+        rpn_lab, rpn_tgt, rcnn_lab, rcnn_tgt = [], [], [], []
+        rois_raw = (rois.data if isinstance(rois, NDArray) else rois) \
+            .reshape(B, net.rpn_post_nms, 5)
+        for i in range(B):
+            lab, dl = self._rpn_targets(anchors, gt_raw[i])
+            rpn_lab.append(lab)
+            rpn_tgt.append(dl)
+            cl, dt = self._rcnn_targets(rois_raw[i], gt_raw[i])
+            rcnn_lab.append(cl)
+            rcnn_tgt.append(dt)
+        rpn_lab = NDArray(jnp.stack(rpn_lab))             # (B, N)
+        rpn_tgt = NDArray(jnp.stack(rpn_tgt))             # (B, N, 4)
+        rcnn_lab = NDArray(jnp.concatenate(rcnn_lab))     # (B*R,)
+        rcnn_tgt = NDArray(jnp.concatenate(rcnn_tgt))     # (B*R, 4)
+
+        # RPN objectness: channels [0:A]=bg, [A:2A]=fg in (H, W, A) order
+        bg = ndop.reshape(ndop.transpose(
+            ndop.slice_axis(rpn_cls, axis=1, begin=0, end=A),
+            axes=(0, 2, 3, 1)), (B, -1))
+        fg = ndop.reshape(ndop.transpose(
+            ndop.slice_axis(rpn_cls, axis=1, begin=A, end=2 * A),
+            axes=(0, 2, 3, 1)), (B, -1))
+        logits = ndop.stack(bg, fg, axis=1)               # (B, 2, N)
+        logp = ndop.log_softmax(logits, axis=1)
+        valid = rpn_lab >= 0
+        picked = ndop.pick(logp, rpn_lab * valid, axis=1)
+        rpn_cls_loss = -(picked * valid).sum() / valid.sum()
+
+        # RPN bbox: (B, 4A, H, W) -> (B, N, 4) matching anchor order
+        bp = ndop.reshape(rpn_bbox, (B, A, 4, H, W))
+        bp = ndop.reshape(ndop.transpose(bp, axes=(0, 3, 4, 1, 2)),
+                          (B, -1, 4))
+        pos = rpn_lab == 1
+        rpn_box_loss = (ndop.smooth_l1(bp - rpn_tgt, scalar=3.0)
+                        * pos.expand_dims(-1)).sum() / \
+            ndop.maximum(pos.sum() * 4, 1.0)
+
+        # RCNN class CE over all rois
+        logp2 = ndop.log_softmax(cls_scores, axis=-1)     # (B*R, C+1)
+        rcnn_cls_loss = -ndop.pick(logp2, rcnn_lab, axis=1).mean()
+
+        # RCNN bbox: differentiable class-column pick via one_hot mask
+        dp = ndop.reshape(bbox_pred, (-1, net.classes + 1, 4))
+        onehot = ndop.one_hot(rcnn_lab, net.classes + 1)  # (B*R, C+1)
+        picked_deltas = (dp * onehot.expand_dims(-1)).sum(axis=1)
+        fgm = rcnn_lab > 0
+        rcnn_box_loss = (ndop.smooth_l1(picked_deltas - rcnn_tgt, scalar=1.0)
+                         * fgm.expand_dims(-1)).sum() / \
+            ndop.maximum(fgm.sum() * 4, 1.0)
+
+        return rpn_cls_loss + rpn_box_loss + rcnn_cls_loss + rcnn_box_loss
+
+
+def faster_rcnn_tiny(classes=3, **kwargs):
+    """64x64-image scale config used by the tests/examples."""
+    return FasterRCNN(classes=classes, base_channels=(16, 32, 64),
+                      scales=(1, 2, 4), ratios=(0.5, 1, 2),
+                      rpn_pre_nms=192, rpn_post_nms=32, **kwargs)

@@ -1,0 +1,120 @@
+"""Factorization Machine on sparse CTR features (BASELINE config #4).
+
+Reference anchor: the sparse end-to-end path named in SURVEY.md §7.S7 —
+``example/sparse/factorization_machine/`` driving ``dot(csr, dense)``
+(``src/operator/tensor/dot``), sparse embedding gradients, and
+``row_sparse_pull`` through the dist kvstore.
+
+Model (Rendle 2010, degree-2):
+    y(x) = w0 + <x, w> + 1/2 * sum_f [ (x V)_f^2 - (x^2) (V^2)_f ]
+
+Inputs arrive as CSR batches; the V/w gradients touch only the feature
+rows present in the batch, so after ``backward()`` they cast to
+``row_sparse`` for the kvstore push (the reference's sparse-grad path).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import autograd
+from ..ndarray import op as ndop
+from ..ndarray.ndarray import NDArray, array as nd_array, zeros as nd_zeros
+from ..ndarray.sparse import CSRNDArray, RowSparseNDArray, dot as sp_dot
+
+
+class FactorizationMachine:
+    """Eager FM with explicit sparse-aware parameters.
+
+    Not a HybridBlock: CSR minibatches and row_sparse gradient flow are
+    inherently eager (the reference trains FM through Module + sparse
+    kvstore, not Gluon hybridize)."""
+
+    def __init__(self, num_features, num_factors=8, seed=0):
+        rng = np.random.RandomState(seed)
+        self.w0 = nd_array(np.zeros((1,), np.float32))
+        self.w = nd_array(np.zeros((num_features, 1), np.float32))
+        self.v = nd_array(
+            (rng.randn(num_features, num_factors) * 0.05).astype(np.float32))
+        for p in (self.w0, self.w, self.v):
+            p.attach_grad()
+        self.num_features = num_features
+        self.num_factors = num_factors
+
+    def params(self):
+        return {"fm_w0": self.w0, "fm_w": self.w, "fm_v": self.v}
+
+    def forward(self, x_csr):
+        """x_csr: CSRNDArray (B, F) -> logits (B,)."""
+        linear = sp_dot(x_csr, self.w)                       # (B, 1)
+        xv = sp_dot(x_csr, self.v)                           # (B, K)
+        x2 = CSRNDArray(x_csr.values * x_csr.values, x_csr.indptr,
+                        x_csr.indices, x_csr.shape) \
+            if hasattr(x_csr, "indptr") else x_csr * x_csr
+        v2 = self.v * self.v
+        x2v2 = sp_dot(x2, v2)                                # (B, K)
+        inter = 0.5 * (xv * xv - x2v2).sum(axis=1)           # (B,)
+        return linear.reshape((-1,)) + inter + self.w0
+
+    def loss(self, x_csr, y):
+        """Logistic loss on +-1 labels (CTR convention)."""
+        logits = self.forward(x_csr)
+        return ndop.log(1.0 + ndop.exp(-y * logits)).mean()
+
+    def grad_rsp(self, param):
+        """Cast a dense param gradient to row_sparse (rows touched by the
+        batch) for the kvstore push — the sparse-grad wire format."""
+        raw = param.grad.data
+        nz = jnp.any(raw != 0, axis=tuple(range(1, raw.ndim)))
+        nz_host = np.nonzero(np.asarray(nz))[0].astype(np.int32)
+        vals = np.asarray(raw)[nz_host]
+        return RowSparseNDArray(vals, nz_host, raw.shape)
+
+
+def synthetic_ctr(num_samples, num_features, nnz_per_row=8, seed=0):
+    """Synthetic CTR data: sparse one-hot-ish rows, labels from a planted
+    low-rank interaction model (so FM can actually fit it)."""
+    rng = np.random.RandomState(seed)
+    indptr = [0]
+    indices = []
+    values = []
+    planted_v = rng.randn(num_features, 4) * 0.5
+    planted_w = rng.randn(num_features) * 0.3
+    labels = []
+    for _ in range(num_samples):
+        cols = rng.choice(num_features, size=nnz_per_row, replace=False)
+        vals = np.ones(nnz_per_row, np.float32)
+        indices.extend(cols.tolist())
+        values.extend(vals.tolist())
+        indptr.append(len(indices))
+        xv = planted_v[cols].sum(0)
+        score = planted_w[cols].sum() + 0.5 * (
+            (xv ** 2).sum() - (planted_v[cols] ** 2).sum())
+        labels.append(1.0 if score > 0 else -1.0)
+    return (np.array(values, np.float32), np.array(indptr, np.int32),
+            np.array(indices, np.int32), np.array(labels, np.float32))
+
+
+def train_step(fm, x_csr, y, kv=None, lr=0.05):
+    """One FM step: record -> backward -> (optionally) push row_sparse
+    grads through the kvstore -> SGD update. Returns the loss value."""
+    with autograd.record():
+        l = fm.loss(x_csr, y)
+    l.backward()
+    updates = [("fm_w", fm.w), ("fm_v", fm.v)]
+    if kv is not None:
+        for name, p in updates:
+            kv.push(name, fm.grad_rsp(p))
+        # pull only the rows this worker's batch touched (plus row 0):
+        # the reference row_sparse_pull contract
+        for name, p in updates:
+            rows = nd_array(np.arange(p.shape[0]).astype(np.int32))
+            kv.row_sparse_pull(name, out=p, row_ids=rows)
+        kv.push("fm_w0", fm.w0.grad)
+        kv.pull("fm_w0", out=fm.w0)
+    else:
+        for _, p in updates:
+            p._set_data((p - lr * p.grad).data)
+        fm.w0._set_data((fm.w0 - lr * fm.w0.grad).data)
+    return float(l.asnumpy())

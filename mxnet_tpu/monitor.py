@@ -1,7 +1,13 @@
 """``mx.monitor`` — per-op/per-parameter output statistics.
 
-Reference: ``python/mxnet/monitor.py`` (engine output callback). TPU-native:
-taps Gluon block outputs via forward hooks instead of engine callbacks.
+Reference: ``python/mxnet/monitor.py`` (engine output callback). TPU-native
+equivalents of both reference tap points:
+
+- block-level: Gluon forward hooks (``install``), and
+- op-level: a dispatch callback (``install_ops``) that mirrors the
+  reference's ``MXExecutorSetMonitorCallback`` engine hook — every eager
+  op dispatched through ``ops.dispatch.apply_op`` between ``tic``/``toc``
+  reports its outputs.
 """
 
 from __future__ import annotations
@@ -10,6 +16,34 @@ import logging
 import re
 
 from .ndarray.ndarray import NDArray
+
+# dispatch-level tap registry; OP_TAP_ON is the fast-path guard read by
+# ops/dispatch.py on every eager dispatch
+_OP_MONITORS = []
+OP_TAP_ON = False
+
+
+_IN_TAP = False
+
+
+def tap_op(op_name, outputs):
+    """Called by ops.dispatch.apply_op for every eager op when enabled.
+    Reentrancy-guarded: the stat functions themselves dispatch ops."""
+    global _IN_TAP
+    if _IN_TAP:
+        return
+    from . import autograd
+
+    _IN_TAP = True
+    try:
+        # pause autograd: stat math must not land on the tape (it would
+        # pin vjp closures until toc(); the reference engine callback
+        # likewise runs outside autograd)
+        with autograd.pause():
+            for mon in _OP_MONITORS:
+                mon._tap_op(op_name, outputs)
+    finally:
+        _IN_TAP = False
 
 
 class Monitor:
@@ -48,10 +82,40 @@ class Monitor:
         self._handles.append(block.register_forward_hook(hook))
         return self
 
+    def install_ops(self):
+        """Tap EVERY eagerly-dispatched op's outputs (reference:
+        ``Monitor.install_to_executor`` / the engine monitor callback)."""
+        global OP_TAP_ON
+        if self not in _OP_MONITORS:
+            _OP_MONITORS.append(self)
+        OP_TAP_ON = True
+        self._op_seq = {}
+        return self
+
+    def uninstall_ops(self):
+        global OP_TAP_ON
+        if self in _OP_MONITORS:
+            _OP_MONITORS.remove(self)
+        OP_TAP_ON = bool(_OP_MONITORS)
+        return self
+
+    def _tap_op(self, op_name, outputs):
+        if not self.activated:
+            return
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        seq = self._op_seq.get(op_name, 0) if hasattr(self, "_op_seq") else 0
+        if hasattr(self, "_op_seq"):
+            self._op_seq[op_name] = seq + 1
+        for i, o in enumerate(outs):
+            name = f"{op_name}{seq}_output{i}"
+            if self.re_prog.match(name) and isinstance(o, NDArray):
+                self.queue.append((self.step, name, self.stat_func(o)))
+
     def tic(self):
         if self.step % self.interval == 0:
             self.queue = []
             self.activated = True
+            self._op_seq = {}
         self.step += 1
 
     def toc(self):

@@ -47,6 +47,23 @@ def _run_timed(opdef, fn, raw):
     return res
 
 
+_MONITOR = None
+
+
+def _tap_monitor(opdef, result):
+    """Per-op output tap (reference: the engine monitor callback behind
+    ``MXExecutorSetMonitorCallback``); no-op unless a Monitor called
+    ``install_ops()``."""
+    global _MONITOR
+    if _MONITOR is None:
+        from .. import monitor as _MONITOR_mod
+
+        _MONITOR = _MONITOR_mod
+    if _MONITOR.OP_TAP_ON:
+        _MONITOR.tap_op(opdef.name, result)
+    return result
+
+
 def _unwrap(x):
     from ..ndarray.ndarray import NDArray
 
@@ -74,7 +91,7 @@ def apply_op(opdef: OpDef, args, kwargs, out=None):
             return _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out)
 
     res = _maybe_sync(_run_timed(opdef, jitted(opdef, kwargs), raw))
-    return _wrap_result(res, ctx, out)
+    return _tap_monitor(opdef, _wrap_result(res, ctx, out))
 
 
 def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
@@ -100,7 +117,7 @@ def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
     node.out_arrays = list(outs)
     for k, o in enumerate(outs):
         o._ag = (node, k)
-    return result
+    return _tap_monitor(opdef, result)
 
 
 def invoke(name, *args, **kwargs):

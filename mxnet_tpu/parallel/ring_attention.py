@@ -43,7 +43,7 @@ def ring_attention(query, key, value, mesh, axis_name="sp", scale=None,
     across the axis. Returns the global (B, H, T, D) result with the same
     sharding. Jit-able; collectives lower to ICI ppermute.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     if scale is None:
         scale = 1.0 / (query.shape[-1] ** 0.5)
@@ -85,11 +85,15 @@ def ring_attention(query, key, value, mesh, axis_name="sp", scale=None,
 
         B, H, Tl, D = q.shape
 
-        def _vary(x):  # mark constants as varying over the ring axis so the
-            try:       # scan carry types match (shard_map varying-axes check)
+        def _vary(x):
+            # mark constants as varying over the ring axis so the scan
+            # carry types match shard_map's varying-axes check; the API
+            # was lax.pvary (<=0.8, deprecated) and is lax.pcast in 0.9+
+            if hasattr(lax, "pcast"):
+                return lax.pcast(x, (axis_name,), to="varying")
+            if hasattr(lax, "pvary"):  # pragma: no cover (old jax)
                 return lax.pvary(x, axis_name)
-            except AttributeError:  # older jax: implicit
-                return x
+            return x  # pragma: no cover
 
         init = (
             _vary(jnp.zeros((B, H, Tl, D), jnp.float32)),

@@ -436,8 +436,17 @@ def load_json(json_str):
     return heads[0] if len(heads) == 1 else Group(heads)
 
 
-_DTYPE_FLAG_NAMES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
-                     4: "int32", 5: "int8", 6: "int64", 12: "bfloat16"}
+def _dtype_flag_names():
+    """mshadow type-flag -> numpy name, derived from the single source of
+    truth in ndarray.serialization (the .params serializer's table)."""
+    from ..ndarray import serialization as _ser
+
+    names = {f: _np.dtype(t).name for f, t in _ser._TYPE_FLAG_TO_NP.items()}
+    names[_ser._BF16_FLAG] = "bfloat16"
+    return names
+
+
+_DTYPE_FLAG_NAMES = _dtype_flag_names()
 
 
 def _num_outputs_of(op, attrs):

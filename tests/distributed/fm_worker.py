@@ -1,0 +1,77 @@
+"""Multi-process factorization-machine worker (BASELINE config #4:
+sparse embedding grads + dot(csr, dense) + row_sparse push/pull through
+dist_tpu_sync; reference analog: example/sparse/factorization_machine
+trained with --kv-store dist_sync under tools/launch.py).
+
+Each rank trains on its own shard of the same planted CTR problem; the
+row_sparse gradient pushes are summed across workers by the dist store's
+psum; every rank must converge AND end bit-identical (same updates seen
+everywhere).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore.dist import init_distributed
+from mxnet_tpu.models import fm as fm_mod
+from mxnet_tpu.ndarray.sparse import csr_matrix
+
+init_distributed()
+rank = int(os.environ["MXTPU_PROCESS_ID"])
+nworkers = int(os.environ["MXTPU_NUM_PROCESSES"])
+
+kv = mx.kv.create("dist_tpu_sync")
+
+F = 100
+fm = fm_mod.FactorizationMachine(F, num_factors=4, seed=1)
+# per-rank shard of the SAME planted model (seed fixes the planted
+# weights; sample draw differs by rank via the offset)
+vals, indptr, indices, labels = fm_mod.synthetic_ctr(
+    120, F, seed=3)
+lo, hi = rank * (120 // nworkers), (rank + 1) * (120 // nworkers)
+row_slice = slice(lo, hi)
+sub_indptr = indptr[lo:hi + 1] - indptr[lo]
+sub_idx = indices[indptr[lo]:indptr[hi]]
+sub_vals = vals[indptr[lo]:indptr[hi]]
+X = csr_matrix((sub_vals, sub_idx, sub_indptr), shape=(hi - lo, F))
+y = mx.nd.array(labels[lo:hi])
+
+for name, p in fm.params().items():
+    kv.init(name, p)
+
+lr = 0.5
+
+
+def updater(key, grad, weight):
+    # grads arrive SUMMED across workers; average them
+    weight._set_data((weight - (lr / nworkers) * grad).data)
+
+
+kv.set_updater(updater)
+
+first = last = None
+for step in range(300):
+    l = fm_mod.train_step(fm, X, y, kv=kv)
+    if first is None:
+        first = l
+    last = l
+
+assert last < first * 0.5, (first, last)
+pred = np.sign(fm.forward(X).asnumpy())
+acc = float((pred == labels[lo:hi]).mean())
+assert acc > 0.8, acc
+checksum = float(np.abs(fm.v.asnumpy()).sum() + np.abs(fm.w.asnumpy()).sum())
+print(f"FM_WORKER_OK rank={rank}/{nworkers} loss {first:.4f}->{last:.4f} "
+      f"acc={acc:.2f} checksum={checksum:.6f}", flush=True)

@@ -50,3 +50,31 @@ def test_dist_tpu_sync_multiprocess(nworkers):
     for rank in range(nworkers):
         assert f"DIST_WORKER_OK rank={rank}/{nworkers}" in res.stdout, (
             f"rank {rank} missing OK line\nstdout:\n{res.stdout[-4000:]}")
+
+
+FM_WORKER = os.path.join(ROOT, "tests", "distributed", "fm_worker.py")
+
+
+def test_fm_sparse_dist_training():
+    """BASELINE config #4: FM converges on synthetic CTR under
+    tools/launch.py -n 2 with row_sparse gradient pushes, and all ranks
+    end with identical parameters."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, FM_WORKER],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (
+        f"launcher rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
+        f"stderr:\n{res.stderr[-4000:]}")
+    import re
+
+    checks = re.findall(r"FM_WORKER_OK rank=(\d)/2 .*? checksum=([0-9.]+)",
+                        res.stdout)
+    assert len(checks) == 2, res.stdout[-2000:]
+    assert checks[0][1] == checks[1][1], checks  # bit-identical params

@@ -128,3 +128,30 @@ def test_factorization_machine_convergence():
     pred = (net(X).sigmoid().asnumpy() > 0.5).astype(np.float32)
     acc = (pred == y).mean()
     assert acc > 0.85, f"FM failed to converge: {acc}"
+
+
+def test_factorization_machine_end_to_end():
+    """FM on synthetic CTR (BASELINE config #4): dot(csr, dense) forward,
+    sparse-aware grads, convergence; the multi-process kvstore variant
+    lives in tests/distributed/fm_worker.py."""
+    from mxnet_tpu.models import fm as fm_mod
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray, csr_matrix
+
+    F = 80
+    vals, indptr, indices, labels = fm_mod.synthetic_ctr(150, F, seed=3)
+    fm = fm_mod.FactorizationMachine(F, num_factors=4, seed=1)
+    X = csr_matrix((vals, indices, indptr), shape=(150, F))
+    y = mx.nd.array(labels)
+    losses = [fm_mod.train_step(fm, X, y, lr=0.5) for _ in range(150)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    pred = np.sign(fm.forward(X).asnumpy())
+    assert (pred == labels).mean() > 0.9
+
+    # the gradient wire format is row_sparse over touched rows only
+    with mx.autograd.record():
+        l = fm.loss(X, y)
+    l.backward()
+    g = fm.grad_rsp(fm.v)
+    assert isinstance(g, RowSparseNDArray)
+    assert g.indices.shape[0] <= F
+    np.testing.assert_allclose(g.asnumpy(), fm.v.grad.asnumpy(), rtol=1e-5)

@@ -49,7 +49,7 @@ def main():
 
     if args.kv_store == "psum":
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         mesh = Mesh(np.array(jax.devices()), ("dp",))
         x = jax.device_put(
