@@ -320,3 +320,152 @@ def quantize_2bit(grad, residual, threshold=0.5):
     q = jnp.where(acc >= threshold, threshold,
                   jnp.where(acc <= -threshold, -threshold, 0.0))
     return q, acc - q
+
+
+@register("interleaved_matmul_encdec_qk",
+          aliases=("_contrib_interleaved_matmul_encdec_qk",))
+def interleaved_matmul_encdec_qk(queries, keys_values, heads=1):
+    """Encoder-decoder attention scores (reference:
+    contrib/transformer.cc _contrib_interleaved_matmul_encdec_qk):
+    queries (Tq, N, H*D), keys_values (Tk, N, 2*H*D) interleaved k/v;
+    output (N*heads, Tq, Tk)."""
+    Tq, N, HD = queries.shape
+    Tk = keys_values.shape[0]
+    D = HD // heads
+    q = queries.reshape(Tq, N, heads, D).transpose(1, 2, 0, 3) \
+        .reshape(N * heads, Tq, D)
+    kv = keys_values.reshape(Tk, N, heads, 2, D)
+    k = kv[:, :, :, 0].transpose(1, 2, 0, 3).reshape(N * heads, Tk, D)
+    scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
+    return jnp.einsum("btd,bsd->bts", q * scale, k)
+
+
+@register("interleaved_matmul_encdec_valatt",
+          aliases=("_contrib_interleaved_matmul_encdec_valatt",))
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads=1):
+    Tk, N, HD2 = keys_values.shape
+    D = HD2 // (2 * heads)
+    kv = keys_values.reshape(Tk, N, heads, 2, D)
+    v = kv[:, :, :, 1].transpose(1, 2, 0, 3).reshape(N * heads, Tk, D)
+    out = jnp.einsum("bts,bsd->btd", attention, v)
+    Tq = attention.shape[1]
+    return out.reshape(N, heads, Tq, D).transpose(2, 0, 1, 3) \
+        .reshape(Tq, N, heads * D)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c (reference: contrib/quadratic_op.cc — the tutorial
+    op, kept for example parity)."""
+    return a * data * data + b * data + c
+
+
+@register("fft", aliases=("_contrib_fft",))
+def fft(data, compute_size=128):
+    """Real->complex FFT over the last axis with interleaved re/im output
+    (N, ..., 2*d) — the reference's cuFFT wire format (contrib/fft.cc)."""
+    f = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    return jnp.stack([f.real, f.imag], axis=-1) \
+        .reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("ifft", aliases=("_contrib_ifft",))
+def ifft(data, compute_size=128):
+    """Inverse of `fft`: interleaved (.., 2d) -> real (.., d). The
+    reference scales by n (cuFFT unnormalized); we match numpy's 1/n
+    normalization times n = reference convention."""
+    d = data.shape[-1] // 2
+    ri = data.reshape(data.shape[:-1] + (d, 2))
+    comp = ri[..., 0] + 1j * ri[..., 1]
+    return jnp.fft.ifft(comp, axis=-1).real.astype(jnp.float32) * d
+
+
+@register("group_adagrad_update", aliases=("_contrib_group_adagrad_update",))
+def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Per-row (grouped) AdaGrad (reference:
+    contrib/optimizer_op.cc GroupAdagradUpdate): the accumulator keeps ONE
+    scalar per row — mean of squared grads over the embedding dim."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    red_axes = tuple(range(1, g.ndim))
+    hist_new = history + jnp.mean(g * g, axis=red_axes).reshape(
+        history.shape)
+    scale = hist_new.reshape((-1,) + (1,) * (g.ndim - 1))
+    return weight - lr * g / (jnp.sqrt(scale) + epsilon), hist_new
+
+
+@register("masked_softmax")
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0,
+                   normalize=True):
+    """Softmax over `axis` with masked positions forced to 0 probability
+    (reference: masked_softmax in nn/softmax.cc, 1.9). Masked scores use
+    a large-finite fill, not -inf: a fully-masked row (routine padding)
+    would otherwise be NaN, and NaNs poison the vjp even through
+    jnp.where."""
+    x = data / temperature
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, -1e30)
+    out = jax.nn.softmax(x, axis=axis)
+    if mask is not None:
+        out = jnp.where(mask.astype(bool), out, 0.0)
+    return out
+
+
+@register("masked_log_softmax")
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0):
+    x = data / temperature
+    if mask is None:
+        return jax.nn.log_softmax(x, axis=axis)
+    b = mask.astype(bool)
+    out = jax.nn.log_softmax(jnp.where(b, x, -1e30), axis=axis)
+    return jnp.where(b, out, -jnp.inf)  # masked entries report -inf, not NaN
+
+
+@register("sldwin_atten_mask_like",
+          aliases=("_contrib_sldwin_atten_mask_like",))
+def sldwin_atten_mask_like(score, valid_length, dilation=1, w=3,
+                           symmetric=True):
+    """Sliding-window attention mask shaped like `score`
+    (B*H, T, S-band): position (i, j) valid when |i - j*dilation| <= w
+    and both inside valid_length (reference: contrib/sldwin_atten —
+    sparse-band attention for Longformer-style models). ``dilation`` is a
+    static attr: one int, or a per-head tuple of length H that tiles
+    across the B*H leading dim (arrays-first op-surface convention)."""
+    bh, T, S = score.shape
+    rows = jnp.arange(T)[None, :, None]
+    cols = jnp.arange(S)[None, None, :]
+    d = jnp.asarray(dilation)
+    if d.ndim == 0:
+        d = d.reshape(1, 1, 1)
+    else:
+        assert bh % d.shape[0] == 0, (bh, d.shape)
+        d = jnp.tile(d, bh // d.shape[0]).reshape(bh, 1, 1)
+    dist = rows - cols * d
+    band = (dist <= w * d) & (dist >= (-w * d if symmetric else 0))
+    vl = jnp.asarray(valid_length).reshape(-1, 1, 1)
+    inside = (rows < vl) & (cols < vl)
+    return jnp.broadcast_to(band & inside, score.shape).astype(score.dtype)
+
+
+@register("dynamic_reshape", aliases=("_contrib_dynamic_reshape",),
+          jit=False)
+def dynamic_reshape(data, shape_like):
+    """Reshape with the target taken from a TENSOR's values (reference:
+    contrib/dynamic_reshape — host-sync by nature, hence eager)."""
+    import numpy as _host_np
+
+    target = tuple(int(v) for v in _host_np.asarray(shape_like))
+    return data.reshape(target)
+
+
+@register("getnnz", aliases=("_contrib_getnnz",), jit=False)
+def getnnz(data, axis=None):
+    """Count stored (nonzero) values (reference: contrib/nnz.cc on CSR).
+    Dense inputs count exact nonzeros; the CSR NDArray path in
+    ndarray.sparse reports stored values without densifying."""
+    if axis is None:
+        return jnp.sum(data != 0).astype(jnp.int32)
+    return jnp.sum(data != 0, axis=axis).astype(jnp.int32)

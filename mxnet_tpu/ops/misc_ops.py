@@ -1,0 +1,235 @@
+"""Remaining reference op-surface odds and ends.
+
+Reference anchors: ``src/operator/tensor/indexing_op.cc`` (``batch_take``),
+``src/operator/contrib/index_array.cc``/``index_copy.cc`` (``index_add``,
+``index_update``), legacy ``src/operator/swapaxis.cc``-era ops
+(``choose_element_0index``, ``fill_element_0index``), ``amp_cast.cc``
+(``amp_cast``/``amp_multicast``), ``regression_output.cc``
+(``IdentityAttachKLSparseReg`` in ``identity_attach_KL_sparse_reg.cc``),
+``elemwise_sum.cc`` (``add_n``/``ElementWiseSum``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+@register("add_n", aliases=("ElementWiseSum", "elemwise_sum"))
+def add_n(*arrays, num_args=None):
+    acc = arrays[0]
+    for a in arrays[1:]:
+        acc = acc + a
+    return acc
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    """out[i] = a[i, indices[i]] (reference: indexing_op batch_take)."""
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+
+
+@register("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """Legacy alias of batch_take used by old RL examples."""
+    return batch_take(lhs, rhs)
+
+
+@register("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """lhs[i, rhs[i]] = mhs[i] (functional: returns the filled copy)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
+@register("index_add", aliases=("_contrib_index_add",))
+def index_add(a, ind, val):
+    """a[ind] += val with ind (k, N) coordinate columns (reference:
+    contrib/index_add)."""
+    ind = ind.astype(jnp.int32)
+    coords = tuple(ind[i] for i in range(ind.shape[0]))
+    return a.at[coords].add(val)
+
+
+@register("index_update", aliases=("_contrib_index_update",))
+def index_update(a, ind, val):
+    ind = ind.astype(jnp.int32)
+    coords = tuple(ind[i] for i in range(ind.shape[0]))
+    return a.at[coords].set(val)
+
+
+@register("interp")
+def interp(x, xp, fp, left=None, right=None):
+    return jnp.interp(x, xp, fp, left=left, right=right)
+
+
+@register("diagflat")
+def diagflat(data, k=0):
+    return jnp.diagflat(data, k=k)
+
+
+@register("amp_cast")
+def amp_cast(data, dtype="float32"):
+    """AMP graph-rewrite cast (reference: amp_cast.cc). Gradient passes
+    through as identity-with-cast, which jnp.astype's vjp already is."""
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", jit=False)
+def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to a common type: the widest (or narrowest with
+    cast_narrow) floating type among them (reference: amp_multicast)."""
+    dtypes = [a.dtype for a in arrays]
+    order = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
+
+    def rank(dt):
+        for i, o in enumerate(order):
+            if dt == o:
+                return i
+        return len(order)
+
+    pick = min(dtypes, key=rank) if cast_narrow else max(dtypes, key=rank)
+    outs = tuple(a.astype(pick) for a in arrays)
+    return outs if len(outs) > 1 else outs[0]
+
+
+@register("IdentityAttachKLSparseReg",
+          aliases=("identity_attach_KL_sparse_reg",))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1, penalty=0.001,
+                                  momentum=0.9):
+    """Identity forward; backward adds the KL sparsity-penalty gradient on
+    the mean activation (reference: identity_attach_KL_sparse_reg.cc,
+    used to sparsify sigmoid autoencoder activations)."""
+    return data
+
+
+def _kl_fwd(data, sparseness_target, penalty, momentum):
+    return data, data
+
+
+def _kl_bwd(sparseness_target, penalty, momentum, res, g):
+    data = res
+    rho_hat = jnp.mean(data, axis=0, keepdims=True)  # mean over batch
+    rho_hat = jnp.clip(rho_hat, 1e-6, 1 - 1e-6)
+    kl_grad = penalty * (-sparseness_target / rho_hat
+                         + (1.0 - sparseness_target) / (1.0 - rho_hat))
+    return (g + kl_grad / data.shape[0],)
+
+
+identity_attach_kl_sparse_reg.defvjp(_kl_fwd, _kl_bwd)
+
+
+# ---------------------------------------------------------------------------
+# eager random op names: the reference registers the legacy names
+# (`uniform`, `normal`, ...) as ops next to the internal `_random_*` ones
+# (src/operator/random/sample_op.cc registration lists). These return RAW
+# arrays — the dispatch layer wraps them, like any other op.
+# ---------------------------------------------------------------------------
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return (1,)
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def _raw(res):
+    """Unwrap an eager random.py result to the raw array the dispatch
+    layer expects (it wraps op returns itself)."""
+    first = res[0] if isinstance(res, tuple) else res
+    return getattr(first, "data", first) if not isinstance(res, tuple) \
+        else tuple(getattr(r, "data", r) for r in res)
+
+
+# the single implementations live in mxnet_tpu/random.py (the key-stream
+# owners); these registry entries only adapt the op-surface signatures
+# (e.g. `_random_exponential` takes the RATE `lam`, while the random-
+# module function takes the SCALE, mirroring the reference's two APIs)
+
+
+@register("uniform", aliases=("_random_uniform", "random_uniform"), jit=False)
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    from .. import random as _rand
+
+    return _raw(_rand.uniform(low, high, _shape_tuple(shape), dtype))
+
+
+@register("normal", aliases=("_random_normal", "random_normal"), jit=False)
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    from .. import random as _rand
+
+    return _raw(_rand.normal(loc, scale, _shape_tuple(shape), dtype))
+
+
+@register("exponential", aliases=("_random_exponential",
+                                  "random_exponential"), jit=False)
+def exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    from .. import random as _rand
+
+    return _raw(_rand.exponential(1.0 / lam, _shape_tuple(shape), dtype))
+
+
+@register("poisson", aliases=("_random_poisson", "random_poisson"),
+          jit=False)
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    from .. import random as _rand
+
+    return _raw(_rand.poisson(lam, _shape_tuple(shape), dtype))
+
+
+@register("randint", aliases=("_random_randint", "random_randint"),
+          jit=False)
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, **kw):
+    from .. import random as _rand
+
+    return _raw(_rand.randint(low, high, _shape_tuple(shape), dtype))
+
+
+@register("multinomial", jit=False)
+def multinomial(data, shape=1, get_prob=False, dtype="int32", **kw):
+    from .random_ops import sample_multinomial
+
+    n = shape if isinstance(shape, int) else shape[0]
+    res = sample_multinomial(data, shape=None if n == 1 else (n,),
+                             get_prob=get_prob, dtype=dtype)
+    return res
+
+
+@register("shuffle", aliases=("_shuffle",), jit=False)
+def shuffle(data, **kw):
+    from .. import random as _rand
+    from ..ndarray.ndarray import NDArray
+
+    return _raw(_rand.shuffle(NDArray(data)))
+
+
+@register("negative_binomial", aliases=("_random_negative_binomial",
+                                        "random_negative_binomial"),
+          jit=False)
+def negative_binomial(k=1, p=0.5, shape=None, dtype="float32", ctx=None,
+                      **kw):
+    from .random_ops import sample_negative_binomial
+
+    s = _shape_tuple(shape)
+    return sample_negative_binomial(jnp.full(s, float(k)),
+                                    jnp.full(s, float(p)),
+                                    shape=None, dtype=dtype)
+
+
+@register("generalized_negative_binomial",
+          aliases=("_random_generalized_negative_binomial",
+                   "random_generalized_negative_binomial"), jit=False)
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
+                                  dtype="float32", ctx=None, **kw):
+    from .random_ops import sample_generalized_negative_binomial
+
+    s = _shape_tuple(shape)
+    return sample_generalized_negative_binomial(
+        jnp.full(s, float(mu)), jnp.full(s, float(alpha)), shape=None,
+        dtype=dtype)

@@ -219,6 +219,34 @@ def deformable_convolution(data, offset, weight, *maybe_bias, kernel=(3, 3),
     kernel tap into an im2col-style matrix, then one (C*kh*kw) x OHW
     matmul per image rides the MXU.
     """
+    return _deform_conv_impl(data, offset, None, weight,
+                             maybe_bias[0] if maybe_bias and not no_bias
+                             else None, kernel, stride, dilate, pad,
+                             num_filter, num_group, num_deformable_group)
+
+
+@register("ModulatedDeformableConvolution",
+          aliases=("_contrib_ModulatedDeformableConvolution",))
+def modulated_deformable_convolution(data, offset, mask, weight, *maybe_bias,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     dilate=(1, 1), pad=(0, 0), num_filter=0,
+                                     num_group=1, num_deformable_group=1,
+                                     no_bias=False, im2col_step=64,
+                                     workspace=1024, layout=None):
+    """Deformable conv v2 (DCNv2; reference:
+    contrib/modulated_deformable_convolution.cc): each deformed sampling
+    tap is additionally scaled by a learned modulation scalar from
+    ``mask`` (N, dg*kh*kw, OH, OW) — same gather+matmul lowering as v1
+    with the mask folded into the column matrix."""
+    return _deform_conv_impl(data, offset, mask, weight,
+                             maybe_bias[0] if maybe_bias and not no_bias
+                             else None, kernel, stride, dilate, pad,
+                             num_filter, num_group, num_deformable_group)
+
+
+def _deform_conv_impl(data, offset, mask, weight, bias, kernel, stride,
+                      dilate, pad, num_filter, num_group,
+                      num_deformable_group):
     kh, kw = kernel
     sh, sw = stride
     dh, dw = dilate
@@ -271,6 +299,11 @@ def deformable_convolution(data, offset, weight, *maybe_bias, kernel=(3, 3),
         return jax.vmap(per_group)(img, syi, sxi)  # (dg, cg, OH, OW, KH, KW)
 
     cols = jax.vmap(sample_image)(data.reshape(n, dg, cg, h, w), sy, sx)
+    if mask is not None:
+        # DCNv2 modulation: (N, dg*kh*kw, OH, OW) scalar per tap
+        m = mask.reshape(n, dg, kh, kw, oh, ow) \
+            .transpose(0, 1, 4, 5, 2, 3)              # (N,dg,OH,OW,KH,KW)
+        cols = cols * m[:, :, None]                   # broadcast over cg
     # -> (N, C, KH, KW, OH*OW) column matrix, then one matmul on the MXU
     cols = cols.reshape(n, c, oh, ow, kh, kw).transpose(0, 1, 4, 5, 2, 3)
     cols = cols.reshape(n, c * kh * kw, oh * ow)
@@ -283,8 +316,8 @@ def deformable_convolution(data, offset, weight, *maybe_bias, kernel=(3, 3),
         out = jnp.einsum("gfk,ngkp->ngfp", wg, cols_g).reshape(
             n, num_filter, oh * ow)
     out = out.reshape(n, num_filter, oh, ow)
-    if maybe_bias and not no_bias:
-        out = out + maybe_bias[0].reshape(1, -1, 1, 1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
     return out
 
 

@@ -1,0 +1,207 @@
+"""Per-element-parameter sampling ops + density functions.
+
+Reference: ``src/operator/random/sample_op.cc`` (``sample_uniform``,
+``sample_normal``, ``sample_gamma``, ``sample_exponential``,
+``sample_poisson``, ``sample_negative_binomial``,
+``sample_generalized_negative_binomial``, ``sample_multinomial``) and
+``src/operator/random/pdf_op.cc`` (``random_pdf_*``).
+
+``sample_<dist>(params..., shape=s)`` draws ``s`` variates PER parameter
+element: output shape = params.shape + s. TPU-native: ``jax.random`` with
+keys from the framework key stream (``mx.random.seed`` reproducible);
+eager (jit=False) because the key is call-time state — exactly like the
+reference's ``ResourceRequest::kRandom``. The pdf ops are pure jnp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .. import random as _random
+from .registry import register
+
+
+def _tail(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _full_shape(param, shape):
+    return tuple(param.shape) + _tail(shape)
+
+
+# ---------------------------------------------------------------------------
+# sample_* — one draw-set per parameter element
+# ---------------------------------------------------------------------------
+
+
+@register("sample_uniform", aliases=("_sample_uniform",), jit=False)
+def sample_uniform(low, high, shape=None, dtype=None):
+    s = _full_shape(low, shape)
+    u = jax.random.uniform(_random._next_key(), s,
+                           jnp.dtype(dtype or "float32"))
+    ext = (...,) + (None,) * len(_tail(shape))
+    return low[ext] + (high - low)[ext] * u
+
+
+@register("sample_normal", aliases=("_sample_normal",), jit=False)
+def sample_normal(mu, sigma, shape=None, dtype=None):
+    s = _full_shape(mu, shape)
+    z = jax.random.normal(_random._next_key(), s,
+                          jnp.dtype(dtype or "float32"))
+    ext = (...,) + (None,) * len(_tail(shape))
+    return mu[ext] + sigma[ext] * z
+
+
+@register("sample_gamma", aliases=("_sample_gamma",), jit=False)
+def sample_gamma(alpha, beta, shape=None, dtype=None):
+    ext = (...,) + (None,) * len(_tail(shape))
+    a = jnp.broadcast_to(alpha[ext], _full_shape(alpha, shape))
+    g = jax.random.gamma(_random._next_key(), a,
+                         dtype=jnp.dtype(dtype or "float32"))
+    return g * beta[ext]  # beta is the SCALE in the reference
+
+
+@register("sample_exponential", aliases=("_sample_exponential",), jit=False)
+def sample_exponential(lam, shape=None, dtype=None):
+    s = _full_shape(lam, shape)
+    e = jax.random.exponential(_random._next_key(), s,
+                               jnp.dtype(dtype or "float32"))
+    ext = (...,) + (None,) * len(_tail(shape))
+    return e / lam[ext]  # lam is the RATE
+
+
+@register("sample_poisson", aliases=("_sample_poisson",), jit=False)
+def sample_poisson(lam, shape=None, dtype=None):
+    ext = (...,) + (None,) * len(_tail(shape))
+    lam_full = jnp.broadcast_to(lam[ext], _full_shape(lam, shape))
+    p = jax.random.poisson(_random._next_key(), lam_full)
+    return p.astype(jnp.dtype(dtype or "float32"))
+
+
+@register("sample_negative_binomial", aliases=("_sample_negative_binomial",),
+          jit=False)
+def sample_negative_binomial(k, p, shape=None, dtype=None):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (failures before k successes)."""
+    ext = (...,) + (None,) * len(_tail(shape))
+    kf = jnp.broadcast_to(k[ext].astype(jnp.float32),
+                          _full_shape(k, shape))
+    pf = p[ext].astype(jnp.float32)
+    rate = jax.random.gamma(_random._next_key(), kf) * (1.0 - pf) / pf
+    out = jax.random.poisson(_random._next_key(), rate)
+    return out.astype(jnp.dtype(dtype or "float32"))
+
+
+@register("sample_generalized_negative_binomial",
+          aliases=("_sample_generalized_negative_binomial",), jit=False)
+def sample_generalized_negative_binomial(mu, alpha, shape=None, dtype=None):
+    """GNB(mu, alpha) = Poisson(Gamma(1/alpha, mu*alpha))."""
+    ext = (...,) + (None,) * len(_tail(shape))
+    a = jnp.broadcast_to((1.0 / alpha)[ext].astype(jnp.float32),
+                         _full_shape(mu, shape))
+    rate = jax.random.gamma(_random._next_key(), a) * (mu * alpha)[ext]
+    out = jax.random.poisson(_random._next_key(), rate)
+    return out.astype(jnp.dtype(dtype or "float32"))
+
+
+@register("sample_multinomial", aliases=("_sample_multinomial",), jit=False)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """Categorical draws per distribution row; data (..., K) probabilities."""
+    n = _tail(shape) or ()
+    logits = jnp.log(jnp.maximum(data, 1e-38))
+    draws = jax.random.categorical(
+        _random._next_key(), logits[..., None, :] if n else logits,
+        axis=-1, shape=tuple(data.shape[:-1]) + n if n else None)
+    out = draws.astype(jnp.dtype(dtype))
+    if get_prob:
+        logp = jnp.take_along_axis(
+            logits, draws[..., None].astype(jnp.int32), axis=-1)[..., 0] \
+            if not n else jnp.take_along_axis(
+                jnp.broadcast_to(logits[..., None, :],
+                                 tuple(data.shape[:-1]) + n
+                                 + (data.shape[-1],)),
+                draws[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return out, logp
+    return out
+
+
+# ---------------------------------------------------------------------------
+# random_pdf_* — pure density/mass functions (reference pdf_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _maybe_log(val, is_log):
+    return val if is_log else jnp.exp(val)
+
+
+@register("random_pdf_uniform", aliases=("_random_pdf_uniform",))
+def random_pdf_uniform(sample, low, high, is_log=False):
+    logpdf = jnp.where(
+        (sample >= low[..., None]) & (sample <= high[..., None]),
+        -jnp.log((high - low)[..., None]), -jnp.inf)
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_normal", aliases=("_random_pdf_normal",))
+def random_pdf_normal(sample, mu, sigma, is_log=False):
+    z = (sample - mu[..., None]) / sigma[..., None]
+    logpdf = -0.5 * z * z - jnp.log(sigma[..., None]) \
+        - 0.5 * jnp.log(2 * jnp.pi)
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_gamma", aliases=("_random_pdf_gamma",))
+def random_pdf_gamma(sample, alpha, beta, is_log=False):
+    a = alpha[..., None]
+    b = beta[..., None]  # scale
+    logpdf = (a - 1) * jnp.log(sample) - sample / b - jsp.gammaln(a) \
+        - a * jnp.log(b)
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_exponential", aliases=("_random_pdf_exponential",))
+def random_pdf_exponential(sample, lam, is_log=False):
+    logpdf = jnp.log(lam[..., None]) - lam[..., None] * sample
+    return _maybe_log(logpdf, is_log)
+
+
+@register("random_pdf_poisson", aliases=("_random_pdf_poisson",))
+def random_pdf_poisson(sample, lam, is_log=False):
+    logpmf = sample * jnp.log(lam[..., None]) - lam[..., None] \
+        - jsp.gammaln(sample + 1.0)
+    return _maybe_log(logpmf, is_log)
+
+
+@register("random_pdf_negative_binomial",
+          aliases=("_random_pdf_negative_binomial",))
+def random_pdf_negative_binomial(sample, k, p, is_log=False):
+    kk = k[..., None]
+    pp = p[..., None]
+    logpmf = jsp.gammaln(sample + kk) - jsp.gammaln(sample + 1.0) \
+        - jsp.gammaln(kk) + kk * jnp.log(pp) + sample * jnp.log1p(-pp)
+    return _maybe_log(logpmf, is_log)
+
+
+@register("random_pdf_generalized_negative_binomial",
+          aliases=("_random_pdf_generalized_negative_binomial",))
+def random_pdf_generalized_negative_binomial(sample, mu, alpha, is_log=False):
+    r = 1.0 / alpha[..., None]
+    m = mu[..., None]
+    p = r / (r + m)
+    logpmf = jsp.gammaln(sample + r) - jsp.gammaln(sample + 1.0) \
+        - jsp.gammaln(r) + r * jnp.log(p) + sample * jnp.log1p(-p)
+    return _maybe_log(logpmf, is_log)
+
+
+@register("random_pdf_dirichlet", aliases=("_random_pdf_dirichlet",))
+def random_pdf_dirichlet(sample, alpha, is_log=False):
+    a = alpha[..., None, :]  # (..., 1, K) against sample (..., N, K)
+    logpdf = jnp.sum((a - 1.0) * jnp.log(sample), axis=-1) \
+        + jsp.gammaln(jnp.sum(a, axis=-1)) \
+        - jnp.sum(jsp.gammaln(a), axis=-1)
+    return _maybe_log(logpdf, is_log)

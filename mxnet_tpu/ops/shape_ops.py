@@ -307,7 +307,7 @@ def stop_gradient(data):
     return jax.lax.stop_gradient(data)
 
 
-@register("boolean_mask")
+@register("boolean_mask", aliases=("_contrib_boolean_mask",))
 def boolean_mask(data, index, axis=0):
     # dynamic-shape op: TPU-native contract returns padded data + valid count
     # is handled at contrib level; eager path materializes on host semantics

@@ -1,0 +1,560 @@
+"""Round-3 op breadth: optimizer update ops, sample_*/random_pdf_*,
+modulated deformable conv, misc indexing ops, sparse FComputeEx twins.
+
+Each op checks numeric semantics against an independent NumPy
+formulation (reference: the formulas in optimizer_op-inl.h / sample_op.cc
+/ pdf_op.cc), not just shapes.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import op as ndop
+
+
+def _rand(*s):
+    return np.random.RandomState(sum(s) + 7).randn(*s).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# optimizer update ops
+# ---------------------------------------------------------------------------
+
+
+def test_sgd_and_mom_update():
+    w, g, m = _rand(4, 3), _rand(4, 3) * 0.1, np.zeros((4, 3), np.float32)
+    out = ndop.sgd_update(mx.nd.array(w), mx.nd.array(g), 0.1, wd=0.01)
+    want = w - 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+    w2, m2 = ndop.sgd_mom_update(mx.nd.array(w), mx.nd.array(g),
+                                 mx.nd.array(m), 0.1, momentum=0.9, wd=0.01)
+    mom = 0.9 * m - 0.1 * (g + 0.01 * w)
+    np.testing.assert_allclose(w2.asnumpy(), w + mom, rtol=1e-5)
+    np.testing.assert_allclose(m2.asnumpy(), mom, rtol=1e-5)
+
+
+def test_clip_gradient_applies():
+    w, g = np.zeros((3,), np.float32), np.array([10., -10., 0.1], np.float32)
+    out = ndop.sgd_update(mx.nd.array(w), mx.nd.array(g), 1.0,
+                          clip_gradient=1.0)
+    np.testing.assert_allclose(out.asnumpy(), [-1.0, 1.0, -0.1], rtol=1e-6)
+
+
+def test_adam_update():
+    w, g = _rand(5), _rand(5) * 0.1
+    m, v = np.zeros(5, np.float32), np.zeros(5, np.float32)
+    w2, m2, v2 = ndop.adam_update(mx.nd.array(w), mx.nd.array(g),
+                                  mx.nd.array(m), mx.nd.array(v), 0.01,
+                                  beta1=0.9, beta2=0.999, epsilon=1e-8)
+    me = 0.1 * g
+    ve = 0.001 * g * g
+    np.testing.assert_allclose(m2.asnumpy(), me, rtol=1e-5)
+    np.testing.assert_allclose(v2.asnumpy(), ve, rtol=1e-4)
+    np.testing.assert_allclose(w2.asnumpy(),
+                               w - 0.01 * me / (np.sqrt(ve) + 1e-8),
+                               rtol=1e-4)
+
+
+def test_rmsprop_adagrad_adadelta_ftrl():
+    w, g = _rand(6), _rand(6) * 0.2
+    n = np.abs(_rand(6))
+    w2, n2 = ndop.rmsprop_update(mx.nd.array(w), mx.nd.array(g),
+                                 mx.nd.array(n), 0.01, gamma1=0.9)
+    ne = 0.1 * g * g + 0.9 * n
+    np.testing.assert_allclose(n2.asnumpy(), ne, rtol=1e-5)
+    np.testing.assert_allclose(w2.asnumpy(),
+                               w - 0.01 * g / np.sqrt(ne + 1e-8), rtol=1e-4)
+
+    h = np.abs(_rand(6))
+    w2, h2 = ndop.adagrad_update(mx.nd.array(w), mx.nd.array(g),
+                                 mx.nd.array(h), 0.01, epsilon=1e-7)
+    he = h + g * g
+    np.testing.assert_allclose(h2.asnumpy(), he, rtol=1e-5)
+    np.testing.assert_allclose(
+        w2.asnumpy(), w - 0.01 * (g / np.sqrt(he + 1e-7)), rtol=1e-4)
+
+    ag, ad = np.abs(_rand(6)), np.abs(_rand(6))
+    w2, ag2, ad2 = ndop.adadelta_update(mx.nd.array(w), mx.nd.array(g),
+                                        mx.nd.array(ag), mx.nd.array(ad),
+                                        rho=0.9, epsilon=1e-5)
+    age = 0.9 * ag + 0.1 * g * g
+    delta = np.sqrt(ad + 1e-5) / np.sqrt(age + 1e-5) * g
+    np.testing.assert_allclose(w2.asnumpy(), w - delta, rtol=1e-4)
+    np.testing.assert_allclose(ad2.asnumpy(),
+                               0.9 * ad + 0.1 * delta * delta, rtol=1e-4)
+
+    z, nn = _rand(6), np.abs(_rand(6))
+    w2, z2, n2 = ndop.ftrl_update(mx.nd.array(w), mx.nd.array(g),
+                                  mx.nd.array(z), mx.nd.array(nn), 0.1,
+                                  lamda1=0.01, beta=1.0)
+    n_new = nn + g * g
+    sigma = (np.sqrt(n_new) - np.sqrt(nn)) / 0.1
+    z_new = z + g - sigma * w
+    want = np.where(np.abs(z_new) <= 0.01, 0.0,
+                    -(z_new - np.sign(z_new) * 0.01)
+                    / ((1.0 + np.sqrt(n_new)) / 0.1))
+    np.testing.assert_allclose(w2.asnumpy(), want, rtol=1e-4, atol=1e-6)
+
+
+def test_sign_family_and_nag():
+    w, g, m = _rand(4), _rand(4), _rand(4)
+    out = ndop.signsgd_update(mx.nd.array(w), mx.nd.array(g), 0.1, wd=0.01)
+    np.testing.assert_allclose(out.asnumpy(),
+                               (1 - 0.1 * 0.01) * w - 0.1 * np.sign(g),
+                               rtol=1e-5)
+    w2, m2 = ndop.signum_update(mx.nd.array(w), mx.nd.array(g),
+                                mx.nd.array(m), 0.1, momentum=0.9)
+    me = 0.9 * m - 0.1 * g
+    np.testing.assert_allclose(m2.asnumpy(), me, rtol=1e-5)
+    np.testing.assert_allclose(w2.asnumpy(), w + 0.1 * np.sign(me), rtol=1e-5)
+
+    w2, m2 = ndop.nag_mom_update(mx.nd.array(w), mx.nd.array(g),
+                                 mx.nd.array(m), 0.1, momentum=0.9, wd=0.0)
+    me = 0.9 * m + g
+    np.testing.assert_allclose(w2.asnumpy(), w - 0.1 * (g + 0.9 * me),
+                               rtol=1e-5)
+
+
+def test_mp_sgd_keeps_fp32_master():
+    w32 = _rand(4)
+    w16 = w32.astype(np.float16)
+    g16 = (_rand(4) * 0.1).astype(np.float16)
+    w2, w32n = ndop.mp_sgd_update(mx.nd.array(w16, dtype="float16"),
+                                  mx.nd.array(g16, dtype="float16"),
+                                  mx.nd.array(w32), 0.1, wd=0.0)
+    assert w2.dtype == np.float16
+    assert w32n.dtype == np.float32
+    np.testing.assert_allclose(w32n.asnumpy(),
+                               w32 - 0.1 * g16.astype(np.float32), rtol=1e-3)
+
+
+def test_lamb_phases():
+    w, g = _rand(5), _rand(5) * 0.1
+    m, v = np.zeros(5, np.float32), np.zeros(5, np.float32)
+    d, m2, v2 = ndop.lamb_update_phase1(mx.nd.array(w), mx.nd.array(g),
+                                        mx.nd.array(m), mx.nd.array(v),
+                                        beta1=0.9, beta2=0.999, t=1, wd=0.01)
+    mh = (0.1 * g) / (1 - 0.9)
+    vh = (0.001 * g * g) / (1 - 0.999)
+    np.testing.assert_allclose(
+        d.asnumpy(), mh / (np.sqrt(vh) + 1e-6) + 0.01 * w, rtol=1e-3)
+    r1 = np.linalg.norm(w).astype(np.float32)
+    r2 = np.linalg.norm(d.asnumpy()).astype(np.float32)
+    w2 = ndop.lamb_update_phase2(mx.nd.array(w), d, mx.nd.array(r1),
+                                 mx.nd.array(r2), 0.01)
+    np.testing.assert_allclose(w2.asnumpy(),
+                               w - 0.01 * (r1 / r2) * d.asnumpy(), rtol=1e-4)
+
+
+def test_multi_tensor_family():
+    ws = [_rand(3), _rand(2, 2)]
+    gs = [_rand(3) * 0.1, _rand(2, 2) * 0.1]
+    arrays = [mx.nd.array(a) for pair in zip(ws, gs) for a in pair]
+    outs = ndop.multi_sgd_update(*arrays, lrs=(0.1, 0.2), wds=(0.0, 0.0),
+                                 num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(outs[1].asnumpy(), ws[1] - 0.2 * gs[1],
+                               rtol=1e-5)
+
+    sq = ndop.multi_sum_sq(mx.nd.array(ws[0]), mx.nd.array(ws[1]),
+                           num_arrays=2)
+    np.testing.assert_allclose(sq.asnumpy(),
+                               [np.sum(ws[0] ** 2), np.sum(ws[1] ** 2)],
+                               rtol=1e-5)
+
+    lrs = np.array([0.1, 0.1], np.float32)
+    wsq = sq.asnumpy()
+    gsq = np.array([np.sum(gs[0] ** 2), np.sum(gs[1] ** 2)], np.float32)
+    wds = np.array([0.0, 0.0], np.float32)
+    new_lrs = ndop.multi_lars(mx.nd.array(lrs), sq,
+                              mx.nd.array(gsq), mx.nd.array(wds), eta=0.01)
+    want = lrs * 0.01 * np.sqrt(wsq) / (np.sqrt(gsq) + 1e-8)
+    np.testing.assert_allclose(new_lrs.asnumpy(), want, rtol=1e-4)
+
+    # preloaded variant: lrs/wds as trailing arrays
+    outs = ndop.preloaded_multi_sgd_update(
+        *arrays, mx.nd.array(lrs), mx.nd.array(wds), num_weights=2)
+    np.testing.assert_allclose(outs[0].asnumpy(), ws[0] - 0.1 * gs[0],
+                               rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sample_* / random_pdf_*
+# ---------------------------------------------------------------------------
+
+
+def test_sample_ops_shapes_and_moments():
+    mx.random.seed(7)
+    low = mx.nd.array(np.array([0.0, 10.0], np.float32))
+    high = mx.nd.array(np.array([1.0, 20.0], np.float32))
+    s = ndop.sample_uniform(low, high, shape=(4000,))
+    assert s.shape == (2, 4000)
+    m = s.asnumpy().mean(axis=1)
+    np.testing.assert_allclose(m, [0.5, 15.0], atol=0.3)
+
+    mu = mx.nd.array(np.array([-2.0, 3.0], np.float32))
+    sig = mx.nd.array(np.array([1.0, 2.0], np.float32))
+    s = ndop.sample_normal(mu, sig, shape=(4000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [-2, 3], atol=0.2)
+    np.testing.assert_allclose(s.asnumpy().std(axis=1), [1, 2], atol=0.2)
+
+    lam = mx.nd.array(np.array([1.0, 5.0], np.float32))
+    s = ndop.sample_poisson(lam, shape=(4000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [1, 5], atol=0.3)
+
+    s = ndop.sample_exponential(lam, shape=(4000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [1.0, 0.2],
+                               atol=0.15)
+
+    a = mx.nd.array(np.array([2.0], np.float32))
+    b = mx.nd.array(np.array([3.0], np.float32))
+    s = ndop.sample_gamma(a, b, shape=(6000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [6.0], atol=0.5)
+
+    k = mx.nd.array(np.array([4.0], np.float32))
+    p = mx.nd.array(np.array([0.5], np.float32))
+    s = ndop.sample_negative_binomial(k, p, shape=(6000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [4.0], atol=0.5)
+
+    mu = mx.nd.array(np.array([3.0], np.float32))
+    alpha = mx.nd.array(np.array([0.5], np.float32))
+    s = ndop.sample_generalized_negative_binomial(mu, alpha, shape=(6000,))
+    np.testing.assert_allclose(s.asnumpy().mean(axis=1), [3.0], atol=0.5)
+
+
+def test_sample_multinomial_distribution():
+    mx.random.seed(3)
+    probs = mx.nd.array(np.array([[0.8, 0.2], [0.1, 0.9]], np.float32))
+    s = ndop.sample_multinomial(probs, shape=(3000,))
+    freq0 = (s.asnumpy()[0] == 0).mean()
+    freq1 = (s.asnumpy()[1] == 1).mean()
+    assert abs(freq0 - 0.8) < 0.05
+    assert abs(freq1 - 0.9) < 0.05
+
+
+def test_random_pdfs_against_closed_forms():
+    x = mx.nd.array(np.array([[0.3, 0.7]], np.float32))
+    low = mx.nd.array(np.array([0.0], np.float32))
+    high = mx.nd.array(np.array([2.0], np.float32))
+    pdf = ndop.random_pdf_uniform(x, low, high)
+    np.testing.assert_allclose(pdf.asnumpy(), [[0.5, 0.5]], rtol=1e-5)
+
+    mu = mx.nd.array(np.array([0.0], np.float32))
+    sig = mx.nd.array(np.array([1.0], np.float32))
+    pdf = ndop.random_pdf_normal(x, mu, sig)
+    want = np.exp(-np.array([[0.3, 0.7]]) ** 2 / 2) / np.sqrt(2 * np.pi)
+    np.testing.assert_allclose(pdf.asnumpy(), want, rtol=1e-5)
+
+    lam = mx.nd.array(np.array([2.0], np.float32))
+    pdf = ndop.random_pdf_exponential(x, lam)
+    np.testing.assert_allclose(pdf.asnumpy(),
+                               2 * np.exp(-2 * np.array([[0.3, 0.7]])),
+                               rtol=1e-5)
+
+    ks = mx.nd.array(np.array([[1.0, 3.0]], np.float32))
+    pmf = ndop.random_pdf_poisson(ks, lam)
+    from math import factorial
+
+    want = [[2 ** 1 * np.exp(-2) / factorial(1),
+             2 ** 3 * np.exp(-2) / factorial(3)]]
+    np.testing.assert_allclose(pmf.asnumpy(), want, rtol=1e-4)
+
+    alpha = mx.nd.array(np.array([2.0], np.float32))
+    beta = mx.nd.array(np.array([0.5], np.float32))
+    pdf = ndop.random_pdf_gamma(x, alpha, beta)
+    xs = np.array([[0.3, 0.7]])
+    want = xs ** 1 * np.exp(-xs / 0.5) / (0.5 ** 2 * 1.0)  # Γ(2)=1
+    np.testing.assert_allclose(pdf.asnumpy(), want, rtol=1e-4)
+
+
+def test_eager_random_names_registered():
+    mx.random.seed(11)
+    u = ndop.uniform(low=0.0, high=1.0, shape=(100,))
+    assert u.shape == (100,)
+    assert 0 <= float(u.asnumpy().min()) and float(u.asnumpy().max()) <= 1
+    n = ndop.normal(loc=5.0, scale=0.1, shape=(500,))
+    assert abs(float(n.asnumpy().mean()) - 5.0) < 0.1
+    r = ndop.randint(0, 10, shape=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+    sh = ndop.shuffle(mx.nd.array(np.arange(10, dtype=np.float32)))
+    assert sorted(sh.asnumpy().tolist()) == list(range(10))
+
+
+# ---------------------------------------------------------------------------
+# modulated deformable conv + misc
+# ---------------------------------------------------------------------------
+
+
+def test_modulated_deformable_conv_vs_v1():
+    """mask == 1 must reproduce DeformableConvolution exactly; mask == 0
+    must zero the output."""
+    n, c, h, w = 1, 4, 6, 6
+    kh = kw = 3
+    f = 8
+    x = mx.nd.array(_rand(n, c, h, w))
+    offset = mx.nd.array(_rand(n, 2 * kh * kw, h, w) * 0.3)
+    weight = mx.nd.array(_rand(f, c, kh, kw) * 0.1)
+    ones_mask = mx.nd.array(np.ones((n, kh * kw, h, w), np.float32))
+    v1 = ndop.DeformableConvolution(x, offset, weight, kernel=(3, 3),
+                                    pad=(1, 1), num_filter=f, no_bias=True)
+    v2 = ndop.ModulatedDeformableConvolution(
+        x, offset, ones_mask, weight, kernel=(3, 3), pad=(1, 1),
+        num_filter=f, no_bias=True)
+    np.testing.assert_allclose(v2.asnumpy(), v1.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+    zero_mask = mx.nd.array(np.zeros((n, kh * kw, h, w), np.float32))
+    v0 = ndop.ModulatedDeformableConvolution(
+        x, offset, zero_mask, weight, kernel=(3, 3), pad=(1, 1),
+        num_filter=f, no_bias=True)
+    np.testing.assert_allclose(v0.asnumpy(), 0.0, atol=1e-6)
+
+
+def test_batch_take_and_friends():
+    a = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = mx.nd.array(np.array([0, 2, 1, 0], np.float32))
+    out = ndop.batch_take(a, idx)
+    np.testing.assert_array_equal(out.asnumpy(), [0, 5, 7, 9])
+    out = ndop.choose_element_0index(a, idx)
+    np.testing.assert_array_equal(out.asnumpy(), [0, 5, 7, 9])
+    filled = ndop.fill_element_0index(a, mx.nd.array(
+        np.array([-1, -2, -3, -4], np.float32)), idx)
+    got = filled.asnumpy()
+    assert got[0, 0] == -1 and got[1, 2] == -2 and got[2, 1] == -3
+
+
+def test_index_add_update():
+    a = mx.nd.array(np.zeros((3, 3), np.float32))
+    ind = mx.nd.array(np.array([[0, 2], [1, 2]], np.float32))  # coords
+    val = mx.nd.array(np.array([5.0, 7.0], np.float32))
+    out = ndop.index_add(a, ind, val)
+    want = np.zeros((3, 3))
+    want[0, 1] += 5
+    want[2, 2] += 7
+    np.testing.assert_array_equal(out.asnumpy(), want)
+    out = ndop.index_update(out, ind, mx.nd.array(
+        np.array([1.0, 2.0], np.float32)))
+    want[0, 1] = 1
+    want[2, 2] = 2
+    np.testing.assert_array_equal(out.asnumpy(), want)
+
+
+def test_interp_diagflat_addn_amp():
+    x = ndop.interp(mx.nd.array(np.array([0.5, 1.5], np.float32)),
+                    mx.nd.array(np.array([0.0, 1.0, 2.0], np.float32)),
+                    mx.nd.array(np.array([0.0, 10.0, 20.0], np.float32)))
+    np.testing.assert_allclose(x.asnumpy(), [5.0, 15.0], rtol=1e-6)
+
+    d = ndop.diagflat(mx.nd.array(np.array([1.0, 2.0], np.float32)))
+    np.testing.assert_array_equal(d.asnumpy(), [[1, 0], [0, 2]])
+
+    s = ndop.add_n(mx.nd.ones((2, 2)), mx.nd.ones((2, 2)),
+                   mx.nd.ones((2, 2)))
+    np.testing.assert_allclose(s.asnumpy(), 3 * np.ones((2, 2)))
+
+    c = ndop.amp_cast(mx.nd.ones((2,)), dtype="bfloat16")
+    assert str(c.dtype) == "bfloat16"
+    a16 = mx.nd.ones((2,)).astype("bfloat16")
+    a32 = mx.nd.ones((2,))
+    o1, o2 = ndop.amp_multicast(a16, a32, num_outputs=2)
+    assert o1.dtype == np.float32 and o2.dtype == np.float32
+
+
+def test_identity_attach_kl_sparse_reg_grad():
+    from mxnet_tpu import autograd
+
+    x = mx.nd.array(np.full((4, 2), 0.5, np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = ndop.IdentityAttachKLSparseReg(x, sparseness_target=0.1,
+                                           penalty=0.001)
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())  # identity fwd
+    # grad = 1 (from sum) + penalty*KL'(rho_hat=0.5)/batch
+    kl = 0.001 * (-0.1 / 0.5 + 0.9 / 0.5) / 4
+    np.testing.assert_allclose(x.grad.asnumpy(), 1.0 + kl, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# sparse FComputeEx twins
+# ---------------------------------------------------------------------------
+
+
+def test_sparse_elemwise_storage_preserved():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    a = sp.row_sparse_array((np.array([[1., 2.], [3., 4.]], np.float32),
+                             np.array([0, 2])), shape=(4, 2))
+    b = sp.row_sparse_array((np.array([[10., 20.], [30., 40.]], np.float32),
+                             np.array([2, 3])), shape=(4, 2))
+    s = sp.elemwise_add(a, b)
+    assert s.stype == "row_sparse"
+    assert sorted(np.asarray(s.indices.data).tolist()) == [0, 2, 3]
+    np.testing.assert_allclose(s.asnumpy(), a.asnumpy() + b.asnumpy())
+
+    d = sp.elemwise_sub(a, b)
+    np.testing.assert_allclose(d.asnumpy(), a.asnumpy() - b.asnumpy())
+
+    p = sp.elemwise_mul(a, b)
+    assert p.stype == "row_sparse"
+    assert np.asarray(p.indices.data).tolist() == [2]
+    np.testing.assert_allclose(p.asnumpy(), a.asnumpy() * b.asnumpy())
+
+    t = sp.add_n(a, b, a)
+    np.testing.assert_allclose(t.asnumpy(),
+                               2 * a.asnumpy() + b.asnumpy())
+    assert t.stype == "row_sparse"
+
+
+def test_sparse_value_maps_and_clip():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    a = sp.row_sparse_array((np.array([[-1., 4.], [9., -16.]], np.float32),
+                             np.array([1, 3])), shape=(5, 2))
+    sq = sp.square(a)
+    assert sq.stype == "row_sparse"
+    np.testing.assert_allclose(sq.asnumpy(), a.asnumpy() ** 2)
+
+    sg = sp.sign(a)
+    np.testing.assert_allclose(sg.asnumpy(), np.sign(a.asnumpy()))
+
+    r = sp.relu(a)
+    np.testing.assert_allclose(r.asnumpy(), np.maximum(a.asnumpy(), 0))
+
+    m = sp.scalar_mul(a, 2.0)
+    assert m.stype == "row_sparse"
+    np.testing.assert_allclose(m.asnumpy(), 2 * a.asnumpy())
+
+    c = sp.clip(a, -2.0, 2.0)  # 0 inside range -> stays sparse
+    assert c.stype == "row_sparse"
+    np.testing.assert_allclose(c.asnumpy(), np.clip(a.asnumpy(), -2, 2))
+    c2 = sp.clip(a, 1.0, 2.0)  # 0 outside range -> dense fallback
+    assert not isinstance(c2, sp.BaseSparseNDArray)
+    np.testing.assert_allclose(c2.asnumpy(), np.clip(a.asnumpy(), 1, 2))
+
+    total = sp.sum(a)
+    np.testing.assert_allclose(total.asnumpy(), a.asnumpy().sum())
+
+
+def test_csr_value_map():
+    from mxnet_tpu.ndarray import sparse as sp
+
+    m = sp.csr_matrix(np.array([[0, 2., 0], [3., 0, 4.]], np.float32))
+    sq = sp.square(m)
+    assert sq.stype == "csr"
+    np.testing.assert_allclose(sq.asnumpy(), m.asnumpy() ** 2)
+
+
+def test_encdec_interleaved_matmul():
+    """encdec qk/valatt vs a plain attention computed from the same
+    interleaved tensors."""
+    Tq, Tk, N, H, D = 3, 5, 2, 2, 4
+    rng = np.random.RandomState(0)
+    q = rng.randn(Tq, N, H * D).astype(np.float32)
+    kv = rng.randn(Tk, N, 2 * H * D).astype(np.float32)
+    scores = ndop.interleaved_matmul_encdec_qk(
+        mx.nd.array(q), mx.nd.array(kv), heads=H)
+    assert scores.shape == (N * H, Tq, Tk)
+    # reference math
+    qr = q.reshape(Tq, N, H, D).transpose(1, 2, 0, 3).reshape(N * H, Tq, D)
+    kvr = kv.reshape(Tk, N, H, 2, D)
+    kr = kvr[:, :, :, 0].transpose(1, 2, 0, 3).reshape(N * H, Tk, D)
+    want = np.einsum("btd,bsd->bts", qr / np.sqrt(D), kr)
+    np.testing.assert_allclose(scores.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+    att = np.abs(rng.randn(N * H, Tq, Tk)).astype(np.float32)
+    out = ndop.interleaved_matmul_encdec_valatt(
+        mx.nd.array(kv), mx.nd.array(att), heads=H)
+    assert out.shape == (Tq, N, H * D)
+    vr = kvr[:, :, :, 1].transpose(1, 2, 0, 3).reshape(N * H, Tk, D)
+    wanto = np.einsum("bts,bsd->btd", att, vr).reshape(N, H, Tq, D) \
+        .transpose(2, 0, 1, 3).reshape(Tq, N, H * D)
+    np.testing.assert_allclose(out.asnumpy(), wanto, rtol=1e-4, atol=1e-5)
+
+
+def test_fft_roundtrip_and_quadratic():
+    x = mx.nd.array(np.random.RandomState(1).randn(2, 8).astype(np.float32))
+    f = ndop.fft(x)
+    assert f.shape == (2, 16)
+    back = ndop.ifft(f) / 8  # reference cuFFT convention: unnormalized
+    np.testing.assert_allclose(back.asnumpy(), x.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+    q = ndop.quadratic(mx.nd.array(np.array([1., 2.], np.float32)),
+                       a=2.0, b=3.0, c=4.0)
+    np.testing.assert_allclose(q.asnumpy(), [9., 18.])
+
+
+def test_group_adagrad_update():
+    w = _rand(4, 3)
+    g = _rand(4, 3) * 0.1
+    h = np.abs(_rand(4))
+    w2, h2 = ndop.group_adagrad_update(mx.nd.array(w), mx.nd.array(g),
+                                       mx.nd.array(h), 0.1)
+    he = h + (g * g).mean(axis=1)
+    np.testing.assert_allclose(h2.asnumpy(), he, rtol=1e-5)
+    np.testing.assert_allclose(
+        w2.asnumpy(), w - 0.1 * g / (np.sqrt(he)[:, None] + 1e-5),
+        rtol=1e-4)
+
+
+def test_masked_softmax():
+    x = mx.nd.array(np.array([[1.0, 2.0, 3.0]], np.float32))
+    m = mx.nd.array(np.array([[1, 1, 0]], np.float32))
+    out = ndop.masked_softmax(x, m).asnumpy()
+    assert out[0, 2] == 0.0
+    np.testing.assert_allclose(out[0, :2],
+                               np.exp([1., 2.]) / np.exp([1., 2.]).sum(),
+                               rtol=1e-5)
+    lout = ndop.masked_log_softmax(x, m).asnumpy()
+    np.testing.assert_allclose(np.exp(lout[0, :2]), out[0, :2], rtol=1e-5)
+    assert np.isneginf(lout[0, 2])
+
+
+def test_dynamic_reshape_and_getnnz():
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    out = ndop.dynamic_reshape(x, mx.nd.array(np.array([2, 3], np.float32)))
+    assert out.shape == (2, 3)
+    n = ndop.getnnz(mx.nd.array(np.array([[0, 1.], [2., 0]], np.float32)))
+    assert int(n.asnumpy()) == 2
+
+
+def test_sparse_value_map_dense_fallback():
+    """Review regression: lambda-based twins must work on dense input."""
+    from mxnet_tpu.ndarray import sparse as sp
+
+    d = mx.nd.array(np.array([-1.0, 2.0], np.float32))
+    np.testing.assert_allclose(sp.relu(d).asnumpy(), [0.0, 2.0])
+    np.testing.assert_allclose(sp.scalar_mul(d, 3.0).asnumpy(), [-3.0, 6.0])
+    np.testing.assert_allclose(sp.square(d).asnumpy(), [1.0, 4.0])
+
+
+def test_masked_softmax_fully_masked_row():
+    """Review regression: padding rows must not produce NaN."""
+    x = mx.nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    m = mx.nd.array(np.array([[1, 1], [0, 0]], np.float32))
+    out = ndop.masked_softmax(x, m).asnumpy()
+    assert not np.isnan(out).any()
+    np.testing.assert_allclose(out[1], [0.0, 0.0])
+    lout = ndop.masked_log_softmax(x, m).asnumpy()
+    assert not np.isnan(lout).any()
+    assert np.isneginf(lout[1]).all()
+
+
+def test_sldwin_mask_dilation():
+    """Review regression: scalar dilation must actually dilate."""
+    score = mx.nd.array(np.zeros((2, 5, 5), np.float32))
+    vl = mx.nd.array(np.array([5, 5], np.float32))
+    m1 = ndop.sldwin_atten_mask_like(score, vl, dilation=1, w=1).asnumpy()
+    m2 = ndop.sldwin_atten_mask_like(score, vl, dilation=2, w=1).asnumpy()
+    assert not np.array_equal(m1, m2)
+    # dilation=2, w=1: row 2 attends cols j with |2 - 2j| <= 2 -> j in {0,1,2}
+    np.testing.assert_array_equal(m2[0, 2], [1, 1, 1, 0, 0])
+    # per-head dilation tuple with B*H=2, heads=2
+    m3 = ndop.sldwin_atten_mask_like(score, vl, dilation=(1, 2),
+                                     w=1).asnumpy()
+    np.testing.assert_array_equal(m3[0], m1[0])
+    np.testing.assert_array_equal(m3[1], m2[1])
