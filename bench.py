@@ -88,16 +88,16 @@ def bench_resnet(backend):
         x = x.astype(dtype)
     y = mx.nd.array(np.random.randint(0, 10, (batch,)).astype(np.float32))
 
-    for _ in range(5):  # compile + settle
-        loss = step(x, y, lr=0.05, sync=False)
-    engine.wait(loss)
+    # warmup compiles both the single step and the bulked loop
+    loss = step(x, y, lr=0.05, sync=False)
+    engine.wait(step.run_steps(x, y, 3, lr=0.05))
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y, lr=0.05, sync=False)
-    # the final loss depends on the final params, which chain through every
-    # step: waiting on this one scalar syncs the whole timed window with a
-    # 1-element transfer (a full-param fetch costs seconds at relay bw).
+    # bulked execution (run_steps = fori_loop over the compiled step):
+    # the reference's benchmark path too (MXNET_EXEC_BULK_EXEC_TRAIN
+    # defaults on). One dispatch; waiting on the final loss scalar syncs
+    # the whole window with a 1-element transfer.
+    loss = step.run_steps(x, y, steps, lr=0.05)
     engine.wait(loss)
     dt = time.perf_counter() - t0
 
@@ -164,13 +164,11 @@ def bench_bert(backend):
     x = mx.nd.array(np.random.randint(0, vocab, (batch, seqlen)), dtype="int32")
     y = mx.nd.array(np.random.randint(0, vocab, (batch, seqlen)).astype(np.float32))
 
-    for _ in range(3):
-        loss = step(x, y, lr=1e-4, sync=False)
-    engine.wait(loss)
+    loss = step(x, y, lr=1e-4, sync=False)
+    engine.wait(step.run_steps(x, y, 2, lr=1e-4))
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(x, y, lr=1e-4, sync=False)
+    loss = step.run_steps(x, y, steps, lr=1e-4)
     engine.wait(loss)
     dt = time.perf_counter() - t0
 
@@ -201,7 +199,7 @@ def bench_flash_attention(backend):
     from mxnet_tpu.ops import flash_attention as fa
 
     B, H, T, D = (2, 8, 4096, 64) if backend != "cpu" else (1, 2, 256, 32)
-    n1, n2 = (5, 40) if backend != "cpu" else (1, 3)
+    n1, n2 = (5, 30) if backend != "cpu" else (1, 3)
     q = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
     k = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
     v = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
@@ -214,7 +212,7 @@ def bench_flash_attention(backend):
                            .astype(jnp.float32))
         return jax.grad(loss)(x).astype(x.dtype)
 
-    per_step = chain_time_per_iter(gstep, q, n1, n2)
+    per_step = chain_time_per_iter(gstep, q, n1, n2, reps=2)
     # causal: half the T^2 blocks; fwd 2 matmuls + FA2 bwd 5 => 3.5x fwd pair
     flops_step = 3.5 * (2 * 2 * B * H * T * T * D) / 2
     tflops = flops_step / per_step / 1e12

@@ -1,51 +1,471 @@
-"""ONNX import/export (reference: ``python/mxnet/contrib/onnx/``).
+"""ONNX export/import (reference: ``python/mxnet/contrib/onnx/`` —
+``mx2onnx.export_model`` and ``onnx2mx.import_model``).
 
-The ``onnx`` package is not present in this environment; the API surface
-is kept (reference parity) and gated. For zoo interchange, the supported
-paths are: ``HybridBlock.export`` (symbol JSON + params, loadable by
-``SymbolBlock.imports``) and ``save_parameters``/``load_parameters``.
+TPU-native twist: no ``onnx`` pip package is required — the stable ONNX
+schema subset lives in ``onnx_support/onnx.proto`` (upstream field
+numbers, so files interchange with standard ONNX tooling) and the
+protoc-generated codec is checked in. The graph IR on our side is the
+nnvm-schema symbol graph (symbol.tojson), so anything expressible there
+with a mapped op exports.
 """
 
 from __future__ import annotations
 
+import json
+
+import numpy as np
+
 from ..base import MXNetError
 
 
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise MXNetError(
-            "the onnx package is not installed in this environment; use "
-            "HybridBlock.export / SymbolBlock.imports for model interchange"
-        ) from e
+def _pb():
+    from .onnx_support import onnx_pb2
+
+    return onnx_pb2
 
 
-def _unsupported(what):
-    raise MXNetError(
-        f"onnx.{what} is not implemented in this build (the reference's "
-        "converter maps per-op to onnx nodes; no TPU-side consumer exists "
-        "here). Supported interchange: HybridBlock.export -> symbol JSON + "
-        ".params, loaded via SymbolBlock.imports."
-    )
+_OPSET = 13
+
+# dtype <-> TensorProto.DataType
+_NP_TO_ONNX = {np.dtype(np.float32): 1, np.dtype(np.float64): 11,
+               np.dtype(np.float16): 10, np.dtype(np.int32): 6,
+               np.dtype(np.int64): 7, np.dtype(np.int8): 3,
+               np.dtype(np.uint8): 2, np.dtype(np.bool_): 9}
+_ONNX_TO_NP = {v: k for k, v in _NP_TO_ONNX.items()}
 
 
-def export_model(sym, params, input_shape, input_type=None,
+def _tensor(name, arr, pb):
+    t = pb.TensorProto()
+    t.name = name
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.name == "bfloat16":
+        arr = arr.astype(np.float32)
+    t.data_type = _NP_TO_ONNX[arr.dtype]
+    t.dims.extend(arr.shape)
+    t.raw_data = arr.tobytes()
+    return t
+
+
+def _from_tensor(t):
+    dtype = _ONNX_TO_NP.get(t.data_type)
+    if dtype is None:
+        raise MXNetError(f"unsupported ONNX tensor dtype {t.data_type}")
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dtype)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), np.float32).astype(dtype)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), np.int64).astype(dtype)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), np.int32).astype(dtype)
+    else:
+        arr = np.zeros(0, dtype)
+    return arr.reshape(tuple(t.dims))
+
+
+def _attr(pb, name, value):
+    a = pb.AttributeProto()
+    a.name = name
+    if isinstance(value, bool):
+        a.type = pb.AttributeProto.INT
+        a.i = int(value)
+    elif isinstance(value, int):
+        a.type = pb.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, float):
+        a.type = pb.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, str):
+        a.type = pb.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (tuple, list)):
+        if all(isinstance(v, int) for v in value):
+            a.type = pb.AttributeProto.INTS
+            a.ints.extend(value)
+        else:
+            a.type = pb.AttributeProto.FLOATS
+            a.floats.extend(float(v) for v in value)
+    else:
+        raise MXNetError(f"cannot encode attr {name}={value!r}")
+    return a
+
+
+def _node(pb, op_type, inputs, outputs, name, **attrs):
+    n = pb.NodeProto()
+    n.op_type = op_type
+    n.name = name
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    for k, v in attrs.items():
+        n.attribute.append(_attr(pb, k, v))
+    return n
+
+
+# ---------------------------------------------------------------------------
+# export: nnvm-schema graph -> ONNX GraphProto
+# ---------------------------------------------------------------------------
+
+
+_ACT_MAP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+            "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def export_model(sym, params, input_shapes, input_types=None,
                  onnx_file_path="model.onnx", verbose=False):
-    _require_onnx()
-    _unsupported("export_model")
+    """Symbol + params -> ONNX file (reference: mx2onnx.export_model).
+    ``sym`` may be a Symbol or a path to a -symbol.json file; ``params``
+    a dict of NDArray/ndarray (``arg:``/``aux:`` prefixes accepted) or a
+    .params path. Returns the file path."""
+    from ..ndarray.ndarray import NDArray, load as nd_load
+    from ..symbol import symbol as sym_mod
+
+    pb = _pb()
+    if isinstance(sym, str):
+        sym = sym_mod.load(sym)
+    if isinstance(params, str):
+        params = nd_load(params)
+    clean_params = {}
+    for k, v in (params or {}).items():
+        key = k.split(":", 1)[1] if ":" in k else k
+        clean_params[key] = v.asnumpy() if isinstance(v, NDArray) \
+            else np.asarray(v)
+
+    blob = json.loads(sym.tojson())
+    nodes = blob["nodes"]
+    heads = blob["heads"]
+
+    graph = pb.GraphProto()
+    graph.name = "mxnet_tpu"
+    out_name = {}  # (node_id, out_idx) -> tensor name
+    data_inputs = []
+
+    def tname(nid, idx=0):
+        return out_name[(nid, idx)]
+
+    for nid, n in enumerate(nodes):
+        op = n["op"]
+        name = n["name"]
+        attrs = {k: _parse(v) for k, v in (n.get("attrs") or {}).items()}
+        ins = [tname(i, ix) for i, ix, _ in n.get("inputs", [])]
+        if op == "null":
+            out_name[(nid, 0)] = name
+            if name in clean_params:
+                graph.initializer.append(_tensor(name, clean_params[name],
+                                                 pb))
+            else:
+                data_inputs.append(name)
+            continue
+        out = f"{name}_out"
+        out_name[(nid, 0)] = out
+        if op == "FullyConnected":
+            no_bias = bool(attrs.get("no_bias", False))
+            flatten = bool(attrs.get("flatten", True))
+            src = ins[0]
+            if flatten:
+                flat = f"{name}_flat"
+                graph.node.append(_node(pb, "Flatten", [src], [flat],
+                                        f"{name}_flatten", axis=1))
+                src = flat
+            gemm_in = [src, ins[1]] + ([] if no_bias else [ins[2]])
+            graph.node.append(_node(pb, "Gemm", gemm_in, [out], name,
+                                    alpha=1.0, beta=1.0, transA=0, transB=1))
+        elif op == "Convolution":
+            kernel = attrs["kernel"]
+            pad = attrs.get("pad", (0,) * len(kernel))
+            stride = attrs.get("stride", (1,) * len(kernel))
+            dilate = attrs.get("dilate", (1,) * len(kernel))
+            no_bias = bool(attrs.get("no_bias", False))
+            conv_in = ins[:2] + ([] if no_bias else [ins[2]])
+            graph.node.append(_node(
+                pb, "Conv", conv_in, [out], name,
+                kernel_shape=tuple(kernel), strides=tuple(stride),
+                dilations=tuple(dilate),
+                pads=tuple(pad) + tuple(pad),
+                group=int(attrs.get("num_group", 1))))
+        elif op == "Activation":
+            act = attrs.get("act_type", "relu")
+            if act not in _ACT_MAP:
+                raise MXNetError(f"activation {act} has no ONNX mapping")
+            graph.node.append(_node(pb, _ACT_MAP[act], ins, [out], name))
+        elif op == "BatchNorm":
+            graph.node.append(_node(
+                pb, "BatchNormalization",
+                [ins[0], ins[1], ins[2], ins[3], ins[4]], [out], name,
+                epsilon=float(attrs.get("eps", 1e-3)),
+                momentum=float(attrs.get("momentum", 0.9))))
+        elif op == "Pooling":
+            kernel = tuple(attrs.get("kernel", ()))
+            ptype = attrs.get("pool_type", "max")
+            if attrs.get("global_pool", False):
+                onnx_op = "GlobalAveragePool" if ptype == "avg" \
+                    else "GlobalMaxPool"
+                graph.node.append(_node(pb, onnx_op, ins, [out], name))
+            else:
+                onnx_op = "AveragePool" if ptype == "avg" else "MaxPool"
+                pad = tuple(attrs.get("pad", (0,) * len(kernel)))
+                graph.node.append(_node(
+                    pb, onnx_op, ins, [out], name, kernel_shape=kernel,
+                    strides=tuple(attrs.get("stride", (1,) * len(kernel))),
+                    pads=pad + pad))
+        elif op in ("softmax", "Softmax"):
+            graph.node.append(_node(pb, "Softmax", ins, [out], name,
+                                    axis=int(attrs.get("axis", -1))))
+        elif op == "log_softmax":
+            graph.node.append(_node(pb, "LogSoftmax", ins, [out], name,
+                                    axis=int(attrs.get("axis", -1))))
+        elif op in ("Flatten", "flatten"):
+            graph.node.append(_node(pb, "Flatten", ins, [out], name, axis=1))
+        elif op in ("reshape", "Reshape"):
+            shape = tuple(int(s) for s in attrs.get("shape", ()))
+            shp_name = f"{name}_shape"
+            graph.initializer.append(_tensor(
+                shp_name, np.asarray(shape, np.int64), pb))
+            graph.node.append(_node(pb, "Reshape", [ins[0], shp_name],
+                                    [out], name))
+        elif op in ("broadcast_add", "elemwise_add", "_plus"):
+            graph.node.append(_node(pb, "Add", ins, [out], name))
+        elif op in ("broadcast_sub", "elemwise_sub"):
+            graph.node.append(_node(pb, "Sub", ins, [out], name))
+        elif op in ("broadcast_mul", "elemwise_mul"):
+            graph.node.append(_node(pb, "Mul", ins, [out], name))
+        elif op in ("broadcast_div", "elemwise_div"):
+            graph.node.append(_node(pb, "Div", ins, [out], name))
+        elif op in ("concat", "Concat"):
+            graph.node.append(_node(pb, "Concat", ins, [out], name,
+                                    axis=int(attrs.get("dim", 1))))
+        elif op == "Dropout":
+            graph.node.append(_node(pb, "Dropout", ins[:1], [out], name))
+        elif op == "transpose":
+            graph.node.append(_node(pb, "Transpose", ins, [out], name,
+                                    perm=tuple(attrs.get("axes", ()))))
+        else:
+            raise MXNetError(f"op {op!r} has no ONNX mapping yet "
+                             "(add it to contrib/onnx.py)")
+
+    # graph inputs (data) with shapes
+    shapes = dict(zip(data_inputs, input_shapes)) \
+        if not isinstance(input_shapes, dict) else input_shapes
+    for name in data_inputs:
+        vi = graph.input.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = 1
+        for d in shapes[name]:
+            tt.shape.dim.add().dim_value = int(d)
+    for hid, hidx, _ in heads:
+        vo = graph.output.add()
+        vo.name = tname(hid, hidx)
+        vo.type.tensor_type.elem_type = 1
+
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "3"
+    model.graph.CopyFrom(graph)
+    ops = model.opset_import.add()
+    ops.domain = ""
+    ops.version = _OPSET
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path
+
+
+def _parse(v):
+    from ..symbol.symbol import _attr_parse
+
+    return _attr_parse(v)
+
+
+# ---------------------------------------------------------------------------
+# import: ONNX -> Symbol + params
+# ---------------------------------------------------------------------------
+
+
+_REV_ACT = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+            "Softplus": "softrelu", "Softsign": "softsign"}
 
 
 def import_model(model_file):
-    _require_onnx()
-    _unsupported("import_model")
+    """ONNX file -> (sym, arg_params, aux_params) (reference:
+    onnx2mx.import_model)."""
+    from ..ndarray.ndarray import array as nd_array
+    from ..symbol import symbol as sym_mod
+
+    pb = _pb()
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    params = {t.name: _from_tensor(t) for t in g.initializer}
+    tensors = {}
+    for vi in g.input:
+        if vi.name not in params:
+            tensors[vi.name] = sym_mod.var(vi.name)
+    for name in params:
+        tensors[name] = sym_mod.var(name)
+
+    aux_names = set()
+    for n in g.node:
+        attrs = {a.name: _attr_value(a) for a in n.attribute}
+        ins = [tensors[i] for i in n.input if i]
+        op = n.op_type
+        if op == "Gemm":
+            if (int(attrs.get("transB", 0)) != 1
+                    or int(attrs.get("transA", 0)) != 0
+                    or float(attrs.get("alpha", 1.0)) != 1.0
+                    or float(attrs.get("beta", 1.0)) != 1.0):
+                raise MXNetError(
+                    "only the FC-form Gemm imports (transB=1, transA=0, "
+                    "alpha=beta=1); other forms would silently change "
+                    "numerics")
+            out = sym_mod.Symbol("FullyConnected", {
+                "num_hidden": params[n.input[1]].shape[0]
+                if n.input[1] in params else 0,
+                "no_bias": len(ins) < 3, "flatten": False}, ins,
+                name=n.name or n.output[0])
+        elif op == "Flatten":
+            out = sym_mod.Symbol("Flatten", {}, ins,
+                                 name=n.name or n.output[0])
+        elif op == "Conv":
+            kernel = tuple(attrs.get("kernel_shape", ()))
+            pads = tuple(attrs.get("pads", (0,) * (2 * len(kernel))))
+            _check_symmetric_pads(pads, kernel, op)
+            out = sym_mod.Symbol("Convolution", {
+                "kernel": kernel,
+                "stride": tuple(attrs.get("strides", (1,) * len(kernel))),
+                "dilate": tuple(attrs.get("dilations",
+                                          (1,) * len(kernel))),
+                "pad": pads[:len(kernel)],
+                "num_group": int(attrs.get("group", 1)),
+                "num_filter": params[n.input[1]].shape[0]
+                if n.input[1] in params else 0,
+                "no_bias": len(ins) < 3}, ins, name=n.name or n.output[0])
+        elif op in _REV_ACT:
+            out = sym_mod.Symbol("Activation",
+                                 {"act_type": _REV_ACT[op]}, ins,
+                                 name=n.name or n.output[0])
+        elif op == "BatchNormalization":
+            out = sym_mod.Symbol("BatchNorm", {
+                "eps": float(attrs.get("epsilon", 1e-5)),
+                "momentum": float(attrs.get("momentum", 0.9)),
+                "fix_gamma": False}, ins, name=n.name or n.output[0])
+            aux_names.update(n.input[3:5])
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = tuple(attrs.get("kernel_shape", ()))
+            pads = tuple(attrs.get("pads", (0,) * (2 * len(kernel))))
+            _check_symmetric_pads(pads, kernel, op)
+            out = sym_mod.Symbol("Pooling", {
+                "kernel": kernel,
+                "stride": tuple(attrs.get("strides", (1,) * len(kernel))),
+                "pad": pads[:len(kernel)],
+                "pool_type": "avg" if op == "AveragePool" else "max"},
+                ins, name=n.name or n.output[0])
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            out = sym_mod.Symbol("Pooling", {
+                "kernel": (1, 1), "global_pool": True,
+                "pool_type": "avg" if op == "GlobalAveragePool" else "max"},
+                ins, name=n.name or n.output[0])
+        elif op == "Softmax":
+            out = sym_mod.Symbol("softmax",
+                                 {"axis": int(attrs.get("axis", -1))}, ins,
+                                 name=n.name or n.output[0])
+        elif op == "LogSoftmax":
+            out = sym_mod.Symbol("log_softmax",
+                                 {"axis": int(attrs.get("axis", -1))}, ins,
+                                 name=n.name or n.output[0])
+        elif op == "Reshape":
+            shape = tuple(int(v) for v in params[n.input[1]])
+            out = sym_mod.Symbol("reshape", {"shape": shape}, ins[:1],
+                                 name=n.name or n.output[0])
+        elif op in ("Add", "Sub", "Mul", "Div"):
+            mx_op = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                     "Mul": "broadcast_mul", "Div": "broadcast_div"}[op]
+            out = sym_mod.Symbol(mx_op, {}, ins, name=n.name or n.output[0])
+        elif op == "Concat":
+            out = sym_mod.Symbol("concat",
+                                 {"dim": int(attrs.get("axis", 1))}, ins,
+                                 name=n.name or n.output[0])
+        elif op == "Transpose":
+            out = sym_mod.Symbol("transpose",
+                                 {"axes": tuple(attrs.get("perm", ()))},
+                                 ins, name=n.name or n.output[0])
+        elif op == "Dropout":
+            out = ins[0]
+        else:
+            raise MXNetError(f"ONNX op {op!r} has no import mapping yet")
+        for o in n.output:
+            tensors[o] = out
+
+    outs = [tensors[vo.name] for vo in g.output]
+    sym = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+    arg_params = {k: nd_array(v) for k, v in params.items()
+                  if k not in aux_names and v.dtype != np.int64}
+    aux_params = {k: nd_array(v) for k, v in params.items()
+                  if k in aux_names}
+    return sym, arg_params, aux_params
 
 
-def import_to_gluon(model_file, ctx=None):
-    _require_onnx()
-    _unsupported("import_to_gluon")
+def _check_symmetric_pads(pads, kernel, op):
+    """The mx Convolution/Pooling ops take one pad per spatial dim; an
+    asymmetric ONNX pads vector (begin != end) cannot be represented —
+    refuse rather than silently truncate (TF SAME-padding exports hit
+    this)."""
+    n = len(kernel)
+    if len(pads) == 2 * n and tuple(pads[:n]) != tuple(pads[n:]):
+        raise MXNetError(
+            f"ONNX {op} with asymmetric pads {pads} cannot map to the "
+            "symmetric-pad mx op; re-export with symmetric padding")
+
+
+def _attr_value(a):
+    pb = _pb()
+    if a.type == pb.AttributeProto.INT:
+        return int(a.i)
+    if a.type == pb.AttributeProto.FLOAT:
+        return float(a.f)
+    if a.type == pb.AttributeProto.STRING:
+        return a.s.decode()
+    if a.type == pb.AttributeProto.INTS:
+        return tuple(a.ints)
+    if a.type == pb.AttributeProto.FLOATS:
+        return tuple(a.floats)
+    return None
 
 
 def get_model_metadata(model_file):
-    _require_onnx()
-    _unsupported("get_model_metadata")
+    """Input/output names+shapes of an ONNX file (reference helper)."""
+    pb = _pb()
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def info(vs):
+        out = []
+        for vi in vs:
+            if vi.name in inits:
+                continue
+            dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": info(g.input),
+            "output_tensor_data": info(g.output)}
+
+
+def import_to_gluon(model_file, ctx=None):
+    """ONNX -> SymbolBlock (reference: onnx2mx.import_to_gluon)."""
+    from ..gluon.block import SymbolBlock
+    from ..symbol import symbol as sym_mod
+
+    sym, arg_params, aux_params = import_model(model_file)
+    # graph inputs = arguments that aren't parameters (no second parse)
+    bound = set(arg_params) | set(aux_params)
+    input_names = [n for n in sym.list_arguments() if n not in bound]
+    inputs = [sym_mod.var(n) for n in input_names]
+    params = {}
+    params.update(arg_params)
+    params.update(aux_params)
+    return SymbolBlock(sym, inputs, params)

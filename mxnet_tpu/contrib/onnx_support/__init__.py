@@ -1,0 +1,1 @@
+from . import onnx_pb2  # noqa: F401

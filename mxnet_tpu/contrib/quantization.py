@@ -1,32 +1,278 @@
-"""int8 quantization (reference: ``python/mxnet/contrib/quantization.py``
-over ``src/operator/quantization/``).
+"""int8 post-training quantization (reference:
+``python/mxnet/contrib/quantization.py`` driving
+``src/operator/quantization/``).
 
-Status: document-only for v1 (SURVEY.md §2.2 'quantization/': "document-only
-for v1; XLA int8 later"). The TPU-native path will be XLA int8 dots +
-Pallas quantized kernels; the calibration API is stubbed with clear errors
-so reference scripts fail loudly instead of silently.
+TPU-native implementation: ``quantize_net`` walks a Gluon block built
+from supported layers (Conv2D / Dense / BatchNorm / relu Activation /
+pooling / Flatten / HybridSequential), folds BatchNorm into the
+preceding conv/dense, calibrates activation ranges on real data
+(``calib_mode='naive'`` min/max — the reference's default), and returns
+a :class:`QuantizedNet` whose convs and matmuls execute as
+int8 x int8 -> int32 on the MXU (``ops/quantization.py``), with float
+glue between quantized layers. Per-tensor symmetric int8, like the
+reference's ``quantized_dtype='int8'`` mode.
 """
 
 from __future__ import annotations
 
+import numpy as np
+
+import jax.numpy as jnp
+
 from ..base import MXNetError
-
-_MSG = ("int8 quantization is not yet implemented in the TPU build; "
-        "bf16 (mx.amp) is the supported reduced-precision path. "
-        "XLA int8 matmul support is planned.")
-
-
-def quantize_model(*args, **kwargs):
-    raise MXNetError(_MSG)
+from ..gluon import nn
+from ..ndarray.ndarray import NDArray
+from ..ops import quantization as qops
 
 
-def quantize_net(*args, **kwargs):
-    raise MXNetError(_MSG)
+def _walk(block):
+    """Flatten a block tree into a layer list (supported layers only)."""
+    from ..gluon.nn import HybridSequential, Sequential
+
+    if isinstance(block, (HybridSequential, Sequential)):
+        out = []
+        for child in block._children.values():
+            out.extend(_walk(child))
+        return out
+    return [block]
+
+
+def _fold_bn(weight, bias, bn):
+    """Fold a BatchNorm into the preceding conv/dense weights
+    (reference: the quantizer's bn-fold pass)."""
+    gamma = bn.gamma.data().asnumpy()
+    beta = bn.beta.data().asnumpy()
+    mean = bn.running_mean.data().asnumpy()
+    var = bn.running_var.data().asnumpy()
+    eps = bn._kwargs.get("eps", 1e-5)
+    scale = gamma / np.sqrt(var + eps)
+    w = weight * scale.reshape((-1,) + (1,) * (weight.ndim - 1))
+    b = (bias - mean) * scale + beta if bias is not None \
+        else -mean * scale + beta
+    return w, b
+
+
+def _float_conv(raw, w, b, kw):
+    from ..ndarray import op as ndop
+
+    return ndop.Convolution(
+        NDArray(raw), NDArray(jnp.asarray(w)),
+        None if b is None else NDArray(jnp.asarray(b)),
+        no_bias=b is None, **kw).data
+
+
+def _float_dense(raw, w, b, flatten):
+    from ..ndarray import op as ndop
+
+    return ndop.FullyConnected(
+        NDArray(raw), NDArray(jnp.asarray(w)),
+        None if b is None else NDArray(jnp.asarray(b)),
+        no_bias=b is None, num_hidden=w.shape[0], flatten=flatten).data
+
+
+class QuantizedNet:
+    """Calibrated int8 inference pipeline over a layer list."""
+
+    def __init__(self, stages):
+        self._stages = stages  # list of (kind, payload)
+
+    def __call__(self, x):
+        raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        for kind, p in self._stages:
+            if kind == "float":
+                raw = p["fn"](raw)
+            elif kind == "conv":
+                q, _, _ = qops.quantize(raw, p["min_in"], p["max_in"])
+                acc, mn, mx = qops.quantized_conv(
+                    q, p["qw"], p["qb"], p["min_in"], p["max_in"],
+                    p["min_w"], p["max_w"], p.get("min_b"), p.get("max_b"),
+                    no_bias=p["qb"] is None, **p["kwargs"])
+                sa = 127.0 / max(abs(p["min_in"]), abs(p["max_in"]))
+                sw = 127.0 / max(abs(p["min_w"]), abs(p["max_w"]))
+                raw = acc.astype(jnp.float32) / (sa * sw)
+            elif kind == "dense":
+                q, _, _ = qops.quantize(raw, p["min_in"], p["max_in"])
+                acc, mn, mx = qops.quantized_fully_connected(
+                    q, p["qw"], p["qb"], p["min_in"], p["max_in"],
+                    p["min_w"], p["max_w"], p.get("min_b"), p.get("max_b"),
+                    no_bias=p["qb"] is None, flatten=p["flatten"])
+                sa = 127.0 / max(abs(p["min_in"]), abs(p["max_in"]))
+                sw = 127.0 / max(abs(p["min_w"]), abs(p["max_w"]))
+                raw = acc.astype(jnp.float32) / (sa * sw)
+            elif kind == "relu":
+                raw = jnp.maximum(raw, 0.0)
+            elif kind == "pool":
+                raw = p["fn"](raw)
+            elif kind == "flatten":
+                raw = raw.reshape(raw.shape[0], -1)
+            else:  # pragma: no cover
+                raise MXNetError(f"unknown stage {kind}")
+        return NDArray(raw)
+
+
+def _quantize_weights(w, b):
+    absmax = float(np.abs(w).max()) or 1e-30
+    qw = np.clip(np.round(w * (127.0 / absmax)), -127, 127).astype(np.int8)
+    payload = {"qw": jnp.asarray(qw), "min_w": -absmax, "max_w": absmax}
+    if b is not None:
+        babs = float(np.abs(b).max()) or 1e-30
+        qb = np.clip(np.round(b * (127.0 / babs)), -127, 127).astype(np.int8)
+        payload.update(qb=jnp.asarray(qb), min_b=-babs, max_b=babs)
+    else:
+        payload.update(qb=None)
+    return payload
+
+
+def quantize_net(net, calib_data=None, quantized_dtype="int8",
+                 calib_mode="naive", exclude_layers=()):
+    """Post-training-quantize a supported Gluon block.
+
+    calib_data: iterable of input batches (NDArray or array-like) run
+    through the fp32 net to record per-layer activation ranges.
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is implemented "
+                         "(reference default); use amp for bf16")
+    if calib_mode != "naive":
+        raise MXNetError("calib_mode='naive' (min/max) is the implemented "
+                         "calibration; entropy calibration is a "
+                         "documented drop")
+    layers = _walk(net)
+
+    # --- plan stages, folding BatchNorm into the preceding conv/dense ----
+    plan = []  # (kind, layer, extras)
+    i = 0
+    while i < len(layers):
+        layer = layers[i]
+        nxt = layers[i + 1] if i + 1 < len(layers) else None
+        if isinstance(layer, nn.Conv2D) or isinstance(layer, nn.Dense):
+            w = layer.weight.data().asnumpy().astype(np.float32)
+            b = layer.bias.data().asnumpy().astype(np.float32) \
+                if layer.bias is not None else None
+            if isinstance(nxt, nn.BatchNorm):
+                if layer.act is not None:
+                    # bn(act(conv(x))) cannot fold into the conv:
+                    # bn(relu(z)) != relu(bn(z)) — refuse loudly instead
+                    # of silently changing the math
+                    raise MXNetError(
+                        "BatchNorm after a conv/dense with a FUSED "
+                        "activation cannot be folded; use the "
+                        "conv -> BatchNorm -> Activation ordering")
+                w, b = _fold_bn(w, b, nxt)
+                i += 1
+                nxt = layers[i + 1] if i + 1 < len(layers) else None
+            kind = "conv" if isinstance(layer, nn.Conv2D) else "dense"
+            excluded = layer.name in exclude_layers
+            plan.append((("float_" + kind) if excluded else kind,
+                         layer, (w, b)))
+            if layer.act is not None:
+                if layer.act._act_type != "relu":
+                    raise MXNetError(
+                        f"only relu activations quantize; got "
+                        f"{layer.act._act_type}")
+                plan.append(("relu", None, None))
+        elif isinstance(layer, nn.Activation):
+            plan.append(("relu", None, None))
+        elif isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D,
+                                nn.GlobalAvgPool2D)):
+            plan.append(("pool", layer, None))
+        elif isinstance(layer, nn.Flatten):
+            plan.append(("flatten", None, None))
+        elif isinstance(layer, nn.BatchNorm):
+            raise MXNetError("BatchNorm without a preceding conv/dense "
+                             "cannot be folded — unsupported topology")
+        elif isinstance(layer, nn.Dropout):
+            pass  # identity at inference
+        else:
+            raise MXNetError(
+                f"quantize_net: unsupported layer {type(layer).__name__}")
+        i += 1
+
+    # --- calibration: record input ranges of quantizable stages ----------
+    ranges = {}  # stage index -> [min, max]
+    if calib_data is None:
+        raise MXNetError("calib_data is required for calib_mode='naive'")
+    from ..ndarray import op as ndop
+
+    for batch in calib_data:
+        raw = batch.data if isinstance(batch, NDArray) else jnp.asarray(batch)
+        for si, (kind, layer, extras) in enumerate(plan):
+            if kind in ("conv", "dense", "float_conv", "float_dense"):
+                if not kind.startswith("float_"):
+                    lo = float(jnp.min(raw))
+                    hi = float(jnp.max(raw))
+                    if si in ranges:
+                        ranges[si][0] = min(ranges[si][0], lo)
+                        ranges[si][1] = max(ranges[si][1], hi)
+                    else:
+                        ranges[si] = [lo, hi]
+                kind = kind.replace("float_", "")
+                # run the FOLDED float math (the BN is gone from the plan,
+                # so downstream ranges must see the folded activations)
+                w, b = extras
+                if kind == "conv":
+                    kw = {k: v for k, v in layer._kwargs.items()
+                          if k not in ("no_bias", "layout")}
+                    raw = _float_conv(raw, w, b, kw)
+                else:
+                    raw = _float_dense(raw, w, b, layer._flatten)
+            elif kind == "relu":
+                raw = jnp.maximum(raw, 0.0)
+            elif kind == "pool":
+                raw = layer(NDArray(raw)).data
+            elif kind == "flatten":
+                raw = raw.reshape(raw.shape[0], -1)
+
+    # --- build the quantized pipeline ------------------------------------
+    stages = []
+    for si, (kind, layer, extras) in enumerate(plan):
+        if kind in ("float_conv", "float_dense"):
+            # excluded layer: keep fp32 math with the folded weights
+            w, b = extras
+            if kind == "float_conv":
+                kw = {k: v for k, v in layer._kwargs.items()
+                      if k not in ("no_bias", "layout")}
+                stages.append(("float", {
+                    "fn": (lambda r, _w=w, _b=b, _kw=kw: _float_conv(
+                        r, _w, _b, _kw))}))
+            else:
+                stages.append(("float", {
+                    "fn": (lambda r, _w=w, _b=b, _l=layer: _float_dense(
+                        r, _w, _b, _l._flatten))}))
+        elif kind in ("conv", "dense"):
+            w, b = extras
+            payload = _quantize_weights(w, b)
+            mn, mx = ranges[si]
+            payload.update(min_in=mn, max_in=mx)
+            if kind == "conv":
+                payload["kwargs"] = dict(layer._kwargs)
+                payload["kwargs"].pop("no_bias", None)
+                payload["kwargs"].pop("layout", None)
+            else:
+                payload["flatten"] = layer._flatten
+            stages.append((kind, payload))
+        elif kind == "pool":
+            lay = layer
+            stages.append(("pool", {
+                "fn": (lambda r, _l=lay: _l(NDArray(r)).data)}))
+        else:
+            stages.append((kind, None))
+    return QuantizedNet(stages)
+
+
+# reference-name compatibility wrappers ------------------------------------
+
+
+def quantize_model(sym, arg_params, aux_params, *args, **kwargs):
+    raise MXNetError("quantize_model (Module/symbol flavor) is not "
+                     "implemented; use quantize_net on a Gluon block")
 
 
 def quantize_graph(*args, **kwargs):
-    raise MXNetError(_MSG)
+    raise MXNetError("quantize_graph is subsumed by quantize_net "
+                     "(no nnvm graph pass exists in the TPU build)")
 
 
 def calib_graph(*args, **kwargs):
-    raise MXNetError(_MSG)
+    raise MXNetError("calib_graph is subsumed by quantize_net's "
+                     "calibration loop")

@@ -1,0 +1,239 @@
+"""``mx.contrib.text`` — vocabularies and token embeddings.
+
+Reference: ``python/mxnet/contrib/text/`` (``vocab.Vocabulary``,
+``embedding.TokenEmbedding``/``CustomEmbedding``/``CompositeEmbedding``,
+``utils.count_tokens_from_str``). Pretrained downloads (GloVe/fastText
+S3 fetches) are gated: this environment has no egress, so
+``get_pretrained_file_names`` lists the catalog and constructors raise a
+clear error directing to ``CustomEmbedding`` with a local file.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as nd_array
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (reference: text/utils.py)."""
+    source_str = re.sub(
+        f"({re.escape(token_delim)})|({re.escape(seq_delim)})", " ",
+        source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(source_str.split())
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with reserved tokens (reference:
+    text/vocab.py:Vocabulary). Index 0 is the unknown token."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        reserved_tokens = list(reserved_tokens or [])
+        if unknown_token in reserved_tokens:
+            raise MXNetError("unknown token must not be reserved")
+        if len(set(reserved_tokens)) != len(reserved_tokens):
+            raise MXNetError("reserved tokens must be unique")
+        self._unknown_token = unknown_token
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._reserved_tokens = reserved_tokens or None
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            taken = set(self._idx_to_token)
+            budget = most_freq_count - len(self._idx_to_token) \
+                if most_freq_count is not None else None
+            for tok, freq in pairs:
+                if freq < min_freq or tok in taken:
+                    continue
+                if budget is not None and budget <= 0:
+                    break
+                self._idx_to_token.append(tok)
+                taken.add(tok)
+                if budget is not None:
+                    budget -= 1
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> indices; unknowns map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks
+
+
+class _TokenEmbedding(Vocabulary):
+    """Base: a vocabulary plus an (V, D) vector table."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[idx]
+        out = nd_array(vecs.astype(np.float32))
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        vecs = new_vectors.asnumpy() if isinstance(new_vectors, NDArray) \
+            else np.asarray(new_vectors)
+        vecs = vecs.reshape(len(toks), -1)
+        table = np.array(self._idx_to_vec.asnumpy())  # asnumpy is read-only
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not in the vocabulary")
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd_array(table)
+
+
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a local token-per-line text file:
+    ``token<elem_delim>v1<elem_delim>v2...`` (reference:
+    text/embedding.py:CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        tokens, vectors = [], []
+        with open(pretrained_file_path, encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tok, vals = parts[0], parts[1:]
+                try:
+                    vec = [float(v) for v in vals]
+                except ValueError:
+                    raise MXNetError(
+                        f"line {line_num + 1}: non-numeric vector entry")
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                elif len(vec) != self._vec_len:
+                    raise MXNetError(
+                        f"line {line_num + 1}: inconsistent vector length")
+                tokens.append(tok)
+                vectors.append(vec)
+        keep = [(t, v) for t, v in zip(tokens, vectors)
+                if vocabulary is None or t in vocabulary.token_to_idx]
+        for t, _ in keep:
+            if t not in self._token_to_idx:
+                self._token_to_idx[t] = len(self._idx_to_token)
+                self._idx_to_token.append(t)
+        table = np.zeros((len(self._idx_to_token), self._vec_len),
+                         np.float32)
+        for t, v in keep:
+            table[self._token_to_idx[t]] = v
+        self._idx_to_vec = nd_array(table)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference:
+    text/embedding.py:CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._unknown_token = vocabulary.unknown_token
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._vec_len = sum(e.vec_len for e in token_embeddings)
+        table = np.zeros((len(self._idx_to_token), self._vec_len),
+                         np.float32)
+        col = 0
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+            table[:, col:col + emb.vec_len] = vecs
+            col += emb.vec_len
+        self._idx_to_vec = nd_array(table)
+
+
+# -- pretrained catalog (download-gated: no egress in this environment) ----
+
+_PRETRAINED = {
+    "glove": ["glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+              "glove.6B.200d.txt", "glove.6B.300d.txt",
+              "glove.840B.300d.txt", "glove.twitter.27B.25d.txt",
+              "glove.twitter.27B.50d.txt", "glove.twitter.27B.100d.txt",
+              "glove.twitter.27B.200d.txt"],
+    "fasttext": ["wiki.en.vec", "wiki.simple.vec", "crawl-300d-2M.vec"],
+}
+
+
+def get_pretrained_file_names(embedding_name=None):
+    if embedding_name is None:
+        return dict(_PRETRAINED)
+    if embedding_name not in _PRETRAINED:
+        raise MXNetError(f"unknown embedding {embedding_name!r}; "
+                         f"choose from {sorted(_PRETRAINED)}")
+    return list(_PRETRAINED[embedding_name])
+
+
+class GloVe(_TokenEmbedding):
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "pretrained GloVe downloads need network egress; download the "
+            "file out of band and load it with CustomEmbedding")
+
+
+class FastText(_TokenEmbedding):
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "pretrained fastText downloads need network egress; download "
+            "the file out of band and load it with CustomEmbedding")

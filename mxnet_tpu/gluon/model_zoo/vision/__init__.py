@@ -32,6 +32,7 @@ from .inception import Inception3, inception_v3  # noqa: F401
 from .ssd import SSD, SSDLoss, ssd_tiny, ssd_300  # noqa: F401
 from .faster_rcnn import (FasterRCNN, FasterRCNNLoss,  # noqa: F401
                           faster_rcnn_tiny)
+from .yolo import YOLOv3, YOLOv3Loss, yolo3_tiny, yolo_detect  # noqa: F401
 
 _models = {
     "resnet18_v1": resnet18_v1,
@@ -59,6 +60,7 @@ _models = {
     "inceptionv3": inception_v3,
     "ssd_tiny": ssd_tiny,
     "faster_rcnn_tiny": faster_rcnn_tiny,
+    "yolo3_tiny": yolo3_tiny,
     "ssd_300": ssd_300,
 }
 

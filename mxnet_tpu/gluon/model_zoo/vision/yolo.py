@@ -1,0 +1,286 @@
+"""YOLOv3 (mini): the one-stage anchor-grid detector in the zoo.
+
+Reference anchor: GluonCV ``model_zoo/yolo/yolo3.py`` (``YOLOV3``,
+``YOLOOutputV3``, ``YOLOV3TargetMerger``) — BASELINE config #2 names
+YOLOv3 alongside Faster-RCNN; the core reference repo ships the ops,
+GluonCV composes them.
+
+TPU-native shape discipline: predictions stay on the static anchor grid
+(B, cells*anchors, 5+C) at every scale; target assignment masks rather
+than filters; NMS is the shared static `box_nms`.
+
+Layout per scale s with A anchors and C classes:
+  head output (B, A*(5+C), H, W) -> (B, H*W*A, 5+C)
+  channels: [tx, ty, tw, th, objectness, class logits...]
+  decode: cx = (sigmoid(tx) + col) / W, cy likewise; w = aw * exp(tw)
+  (anchors normalized to image size, the standard YOLOv3 parameterization)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...block import HybridBlock
+from ...nn import Conv2D, HybridSequential, MaxPool2D
+
+
+def _conv_block(channels, stride=1):
+    blk = HybridSequential(prefix="")
+    blk.add(Conv2D(channels, 3, strides=stride, padding=1,
+                   activation="relu"))
+    return blk
+
+
+class YOLOv3(HybridBlock):
+    """Two-scale mini YOLOv3. ``forward(x)`` returns a list of per-scale
+    raw prediction grids [(B, N_s, 5+C)] plus the static per-scale cell
+    metadata used by the decoder/loss."""
+
+    def __init__(self, classes=3, base_channels=(16, 32, 64),
+                 anchors=(((0.1, 0.15), (0.25, 0.3)),
+                          ((0.4, 0.5), (0.7, 0.8))), **kwargs):
+        super().__init__(**kwargs)
+        self.classes = classes
+        self.anchors = tuple(tuple(map(tuple, a)) for a in anchors)
+        self.num_scales = len(self.anchors)
+        self._stem_pools = len(base_channels)  # one MaxPool per stem stage
+        with self.name_scope():
+            self.stem = HybridSequential(prefix="stem_")
+            for c in base_channels:
+                self.stem.add(_conv_block(c))
+                self.stem.add(MaxPool2D(2))
+            self.stages = HybridSequential(prefix="stages_")
+            self.heads = HybridSequential(prefix="heads_")
+            for i, anch in enumerate(self.anchors):
+                stage = HybridSequential(prefix=f"s{i}_")
+                if i > 0:
+                    stage.add(_conv_block(base_channels[-1], stride=2))
+                else:
+                    stage.add(HybridSequential(prefix=""))
+                self.stages.add(stage)
+                self.heads.add(Conv2D(len(anch) * (5 + classes), 1))
+
+    def hybrid_forward(self, F, x):
+        feat = self.stem(x)
+        outs = []
+        for stage, head in zip(self.stages, self.heads):
+            feat = stage(feat)
+            p = head(feat)                       # (B, A*(5+C), H, W)
+            B, _, H, W = p.shape
+            A = len(self.anchors[len(outs)])
+            p = F.reshape(F.transpose(p, axes=(0, 2, 3, 1)),
+                          (B, H * W * A, 5 + self.classes))
+            outs.append(p)
+        return outs
+
+    # -- static grid metadata ---------------------------------------------
+    def grids(self, img_size):
+        """Per-scale (H, W, A, anchor_wh array) for an img_size input."""
+        meta = []
+        s = img_size
+        for _ in range(self._stem_pools):
+            s //= 2
+        for i, anch in enumerate(self.anchors):
+            if i > 0:
+                s //= 2
+            meta.append((s, s, len(anch),
+                         np.asarray(anch, np.float32)))
+        return meta
+
+
+def decode_predictions(preds, grids):
+    """Raw grids -> (B, N, 6+C-1...) decoded [cx, cy, w, h, obj, cls...]
+    in normalized image coordinates (pure jnp; reference YOLOOutputV3)."""
+    decoded = []
+    for p, (H, W, A, anchor_wh) in zip(preds, grids):
+        raw = p.data if hasattr(p, "data") else jnp.asarray(p)
+        B = raw.shape[0]
+        raw = raw.reshape(B, H, W, A, -1)
+        col = jnp.arange(W).reshape(1, 1, W, 1)
+        row = jnp.arange(H).reshape(1, H, 1, 1)
+        cx = (jax_sigmoid(raw[..., 0]) + col) / W
+        cy = (jax_sigmoid(raw[..., 1]) + row) / H
+        aw = jnp.asarray(anchor_wh[:, 0]).reshape(1, 1, 1, A)
+        ah = jnp.asarray(anchor_wh[:, 1]).reshape(1, 1, 1, A)
+        w = aw * jnp.exp(jnp.clip(raw[..., 2], -8, 8))
+        h = ah * jnp.exp(jnp.clip(raw[..., 3], -8, 8))
+        obj = jax_sigmoid(raw[..., 4])
+        cls = jax_sigmoid(raw[..., 5:])
+        out = jnp.concatenate(
+            [jnp.stack([cx, cy, w, h, obj], axis=-1), cls], axis=-1)
+        decoded.append(out.reshape(B, H * W * A, -1))
+    return jnp.concatenate(decoded, axis=1)
+
+
+def jax_sigmoid(x):
+    import jax
+
+    return jax.nn.sigmoid(x)
+
+
+def _bce_logits(ndop, x, t):
+    """Stable BCE-with-logits on NDArrays (one definition for the
+    objectness and class terms)."""
+    return ndop.relu(x) - x * t + ndop.log(1.0 + ndop.exp(-ndop.abs(x)))
+
+
+def yolo_detect(net, x, score_thresh=0.1, nms_thresh=0.45):
+    """Full inference -> (B, N, 6) [cls, score, x1 y1 x2 y2] normalized,
+    suppressed rows -1 (box_nms convention)."""
+    from ....ndarray import op as ndop
+    from ....ndarray.ndarray import NDArray
+
+    preds = net(x)
+    dec = decode_predictions(preds, net.grids(x.shape[2]))
+    cx, cy, w, h, obj = (dec[..., 0], dec[..., 1], dec[..., 2], dec[..., 3],
+                         dec[..., 4])
+    cls_scores = dec[..., 5:] * obj[..., None]
+    cls_id = jnp.argmax(cls_scores, axis=-1).astype(dec.dtype)
+    score = jnp.max(cls_scores, axis=-1)
+    boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                      axis=-1)
+    rows = jnp.concatenate([cls_id[..., None], score[..., None], boxes],
+                           axis=-1)
+    return ndop.box_nms(NDArray(rows), overlap_thresh=nms_thresh,
+                        valid_thresh=score_thresh, coord_start=2,
+                        score_index=1, id_index=0, force_suppress=False)
+
+
+class YOLOv3Loss:
+    """YOLOv3 objective (reference: YOLOV3TargetMerger + YOLOV3Loss):
+    per-gt best-anchor assignment; BCE on objectness — positives 1,
+    negatives 0, except non-assigned cells whose DECODED prediction
+    overlaps a gt above ``ignore_iou``, which are excluded from the
+    objectness loss (the dynamic ignore of the reference); BCE class and
+    L2 on the raw box parameterization at assigned cells."""
+
+    def __init__(self, net, ignore_iou=0.5):
+        self._net = net
+        self._ignore = ignore_iou
+
+    def _ignore_mask(self, preds, grids, gt_raw):
+        """(B, N_s) per scale: 1 where the decoded prediction's IoU with
+        ANY gt exceeds the threshold (computed on detached values)."""
+        dec = decode_predictions([p.detach() for p in preds], grids)
+        cx, cy, w, h = dec[..., 0], dec[..., 1], dec[..., 2], dec[..., 3]
+        boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2, cy + h / 2], axis=-1)  # (B, N, 4)
+        gt = jnp.asarray(gt_raw[..., 1:5])                    # (B, M, 4)
+        x1 = jnp.maximum(boxes[:, :, None, 0], gt[:, None, :, 0])
+        y1 = jnp.maximum(boxes[:, :, None, 1], gt[:, None, :, 1])
+        x2 = jnp.minimum(boxes[:, :, None, 2], gt[:, None, :, 2])
+        y2 = jnp.minimum(boxes[:, :, None, 3], gt[:, None, :, 3])
+        inter = jnp.clip(x2 - x1, 0) * jnp.clip(y2 - y1, 0)
+        area_p = (boxes[:, :, 2] - boxes[:, :, 0]) \
+            * (boxes[:, :, 3] - boxes[:, :, 1])
+        area_g = (gt[:, :, 2] - gt[:, :, 0]) * (gt[:, :, 3] - gt[:, :, 1])
+        iou = inter / jnp.maximum(
+            area_p[:, :, None] + area_g[:, None, :] - inter, 1e-9)
+        best = jnp.max(iou, axis=-1)                          # (B, N)
+        flat = (best > self._ignore).astype(jnp.float32)
+        # split back per scale
+        out = []
+        ofs = 0
+        for H, W, A, _ in grids:
+            n = H * W * A
+            out.append(flat[:, ofs:ofs + n])
+            ofs += n
+        return out
+
+    def _targets(self, grids, gt, dtype):
+        """gt (M, 5) [cls, x1, y1, x2, y2] normalized. Returns per-scale
+        (obj_target, box_target(4), cls_target) flat arrays matched to
+        the prediction layout."""
+        per_scale = []
+        # global best anchor over every (scale, anchor) pair per gt
+        all_anchors = []
+        for si, (H, W, A, wh) in enumerate(grids):
+            for ai in range(A):
+                all_anchors.append((si, ai, wh[ai]))
+        for si, (H, W, A, wh) in enumerate(grids):
+            obj = np.zeros((H, W, A), np.float32)
+            boxt = np.zeros((H, W, A, 4), np.float32)
+            clst = np.zeros((H, W, A), np.int32)
+            for m in range(gt.shape[0]):
+                cls, x1, y1, x2, y2 = gt[m]
+                gw, gh = x2 - x1, y2 - y1
+                if gw <= 0 or gh <= 0:
+                    continue
+                gcx, gcy = (x1 + x2) / 2, (y1 + y2) / 2
+                # IoU of (gw, gh) against each anchor shape (origin-aligned)
+                best, best_key = -1.0, None
+                for (sj, aj, awh) in all_anchors:
+                    iw = min(gw, awh[0])
+                    ih = min(gh, awh[1])
+                    inter = iw * ih
+                    iou = inter / (gw * gh + awh[0] * awh[1] - inter)
+                    if iou > best:
+                        best, best_key = iou, (sj, aj)
+                if best_key[0] != si:
+                    continue
+                aj = best_key[1]
+                ci = min(int(gcx * W), W - 1)
+                ri = min(int(gcy * H), H - 1)
+                obj[ri, ci, aj] = 1.0
+                boxt[ri, ci, aj] = [gcx * W - ci, gcy * H - ri,
+                                    np.log(max(gw / wh[aj][0], 1e-9)),
+                                    np.log(max(gh / wh[aj][1], 1e-9))]
+                clst[ri, ci, aj] = int(cls)
+            per_scale.append((obj.reshape(-1), boxt.reshape(-1, 4),
+                              clst.reshape(-1)))
+        return per_scale
+
+    def __call__(self, preds, gt_boxes, img_size):
+        from ....ndarray import op as ndop
+        from ....ndarray.ndarray import NDArray
+
+        grids = self._net.grids(img_size)
+        gt_raw = np.asarray(gt_boxes.data if hasattr(gt_boxes, "data")
+                            else gt_boxes)
+        B = gt_raw.shape[0]
+        # one assignment pass per sample (covers all scales), reused below
+        per_sample = [self._targets(grids, gt_raw[b], np.float32)
+                      for b in range(B)]
+        ignore = self._ignore_mask(preds, grids, gt_raw)
+        total = None
+        for si, p in enumerate(preds):
+            H, W, A, wh = grids[si]
+            tgt = [per_sample[b][si] for b in range(B)]
+            obj_t = NDArray(jnp.asarray(np.stack([t[0] for t in tgt])))
+            box_t = NDArray(jnp.asarray(np.stack([t[1] for t in tgt])))
+            cls_t = NDArray(jnp.asarray(np.stack([t[2] for t in tgt])))
+            raw = p  # (B, N, 5+C) NDArray
+            txy = ndop.slice_axis(raw, axis=2, begin=0, end=2)
+            twh = ndop.slice_axis(raw, axis=2, begin=2, end=4)
+            tobj = ndop.slice_axis(raw, axis=2, begin=4, end=5) \
+                .reshape((B, -1))
+            tcls = ndop.slice_axis(raw, axis=2, begin=5,
+                                   end=5 + self._net.classes)
+
+            pos = obj_t  # (B, N)
+            npos = ndop.maximum(pos.sum(), 1.0)
+            # objectness BCE: high-IoU non-assigned cells contribute zero
+            # (the ignore mask); positives always count
+            ign = NDArray(ignore[si])
+            weight = pos + (1.0 - pos) * (1.0 - ign)
+            obj_bce = _bce_logits(ndop, tobj, obj_t)
+            obj_loss = (obj_bce * weight).sum() / \
+                ndop.maximum(weight.sum(), 1.0)
+            # box: sigmoid-xy vs fractional offset, raw wh vs log ratio
+            pxy = ndop.sigmoid(txy)
+            bxy = ndop.slice_axis(box_t, axis=2, begin=0, end=2)
+            bwh = ndop.slice_axis(box_t, axis=2, begin=2, end=4)
+            box_loss = (((pxy - bxy) ** 2 + (twh - bwh) ** 2).sum(axis=2)
+                        * pos).sum() / npos
+            # class BCE at positives
+            onehot = ndop.one_hot(cls_t, self._net.classes)
+            cls_bce = _bce_logits(ndop, tcls, onehot)
+            cls_loss = (cls_bce.sum(axis=2) * pos).sum() / npos
+            part = obj_loss + box_loss + 0.5 * cls_loss
+            total = part if total is None else total + part
+        return total
+
+
+def yolo3_tiny(classes=3, **kwargs):
+    return YOLOv3(classes=classes, **kwargs)

@@ -405,6 +405,11 @@ def masked_softmax(data, mask=None, axis=-1, temperature=1.0,
     a large-finite fill, not -inf: a fully-masked row (routine padding)
     would otherwise be NaN, and NaNs poison the vjp even through
     jnp.where."""
+    from ..base import MXNetError
+
+    if not normalize:
+        raise MXNetError("masked_softmax(normalize=False) is not "
+                         "implemented; the normalized mode is")
     x = data / temperature
     if mask is not None:
         x = jnp.where(mask.astype(bool), x, -1e30)

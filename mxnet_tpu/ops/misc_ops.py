@@ -83,7 +83,11 @@ def amp_cast(data, dtype="float32"):
 @register("amp_multicast", jit=False)
 def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
     """Cast all inputs to a common type: the widest (or narrowest with
-    cast_narrow) floating type among them (reference: amp_multicast)."""
+    cast_narrow) floating type among them (reference: amp_multicast —
+    defined over floating inputs only; mixing in integers would silently
+    truncate, so that's an error here)."""
+    from ..base import MXNetError
+
     dtypes = [a.dtype for a in arrays]
     order = [jnp.float16, jnp.bfloat16, jnp.float32, jnp.float64]
 
@@ -91,7 +95,8 @@ def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
         for i, o in enumerate(order):
             if dt == o:
                 return i
-        return len(order)
+        raise MXNetError(
+            f"amp_multicast expects floating inputs; got {dt}")
 
     pick = min(dtypes, key=rank) if cast_narrow else max(dtypes, key=rank)
     outs = tuple(a.astype(pick) for a in arrays)

@@ -176,6 +176,8 @@ class SPMDTrainStep:
         self._names = None
         self._diff = None
         self._io_avals = None
+        self._run_many = None
+        self._last_loss = None
 
     # -- state management -------------------------------------------------
     def _collect(self):
@@ -337,6 +339,55 @@ class SPMDTrainStep:
             params, opt_states, raw_x, raw_y, lr_arr, key)
         self._state = (new_params, new_states)
         return float(loss) if sync else loss
+
+    def run_steps(self, x, y, n, lr=0.01):
+        """Run ``n`` steps on one batch inside a single executable
+        (``lax.fori_loop`` over the compiled step) — the analog of the
+        reference's bulked execution (``MXNET_EXEC_BULK_EXEC_TRAIN``):
+        one dispatch instead of n, which matters on dispatch-latency-
+        bound backends (the axon relay adds ~10ms/step to the Python
+        loop). Per-step RNG keys are folded from one base key. Returns
+        the final loss (device scalar)."""
+        if self._state is None or self._compiled is None \
+                or self._last_loss is None:
+            # one plain step: resolves deferred init, compiles the inner
+            # step, and seeds the loss carry with the right dtype
+            self._last_loss = self(x, y, lr=lr, sync=False)
+            n -= 1
+            if n <= 0:
+                return self._last_loss
+        raw_x = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        raw_y = y.data if isinstance(y, NDArray) else jnp.asarray(y)
+        if self.mesh is not None:
+            raw_x = shard_batch(NDArray(raw_x), self.mesh, self.batch_axis)
+            raw_y = shard_batch(NDArray(raw_y), self.mesh, self.batch_axis)
+        lr_arr = jnp.asarray(lr, raw_x.dtype
+                             if raw_x.dtype in (jnp.float32, jnp.bfloat16)
+                             else jnp.float32)
+        base_key = _random._next_key()
+        inner = self._compiled
+
+        if self._run_many is None:
+            def many(params, opt_states, xx, yy, lr_a, key, loss0, n_steps):
+                def body(i, c):
+                    p, s, _ = c
+                    return inner(p, s, xx, yy, lr_a,
+                                 jax.random.fold_in(key, i))
+
+                # n_steps is a TRACED bound (lowers to while_loop): one
+                # compile covers every n
+                return jax.lax.fori_loop(0, n_steps, body,
+                                         (params, opt_states, loss0))
+
+            donate = (0, 1) if self._donate else ()
+            self._run_many = jax.jit(many, donate_argnums=donate)
+        params, opt_states = self._state
+        new_params, new_states, loss = self._run_many(
+            params, opt_states, raw_x, raw_y, lr_arr, base_key,
+            self._last_loss, jnp.asarray(n, jnp.int32))
+        self._state = (new_params, new_states)
+        self._last_loss = loss
+        return loss
 
     def cost_analysis(self):
         """XLA's cost analysis for the compiled step (``{"flops": ...}``),

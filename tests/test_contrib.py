@@ -1,5 +1,7 @@
 """Contrib ops / control flow / custom op / AMP tests."""
 
+import collections
+
 import numpy as np
 import pytest
 
@@ -196,3 +198,61 @@ def test_interleaved_selfatt():
     probs = mx.nd.softmax(att, axis=-1)
     out = mx.nd.contrib.interleaved_matmul_selfatt_valatt(qkv, probs, heads=H)
     assert out.shape == (T, N, H * D)
+
+
+def test_text_vocabulary():
+    import collections
+
+    from mxnet_tpu.contrib import text
+
+    counter = text.count_tokens_from_str("a b b c c c\nd d d d", to_lower=True)
+    assert counter["c"] == 3 and counter["d"] == 4
+    vocab = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                            reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then by frequency desc: d, c, b ('a' dropped: freq 1)
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([2, 0]) == ["d", "<unk>"]
+    assert len(vocab) == 5
+
+    capped = text.Vocabulary(counter, most_freq_count=3)
+    assert len(capped) == 3  # <unk> + 2 most frequent
+
+
+def test_text_custom_embedding(tmp_path):
+    import numpy as np
+
+    from mxnet_tpu.contrib import text
+
+    p = tmp_path / "vecs.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    v = emb.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(v, [4.0, 5.0, 6.0])
+    unk = emb.get_vecs_by_tokens("nope").asnumpy()
+    np.testing.assert_allclose(unk, [0.0, 0.0, 0.0])
+
+    emb.update_token_vectors("hello", mx.nd.array(
+        np.array([9.0, 9.0, 9.0], np.float32)))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9.0, 9.0, 9.0])
+
+    # composite over a vocabulary
+    vocab = text.Vocabulary(collections.Counter(["hello", "world"]))
+    comp = text.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 6
+    vv = comp.get_vecs_by_tokens("world").asnumpy()
+    np.testing.assert_allclose(vv, [4., 5., 6., 4., 5., 6.])
+
+
+def test_text_pretrained_gated():
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib import text
+
+    names = text.get_pretrained_file_names("glove")
+    assert "glove.6B.300d.txt" in names
+    with _pytest.raises(MXNetError):
+        text.GloVe("glove.6B.50d.txt")

