@@ -217,6 +217,10 @@ def export_model(sym, params, input_shapes, input_types=None,
             graph.node.append(_node(pb, "Flatten", ins, [out], name, axis=1))
         elif op in ("reshape", "Reshape"):
             shape = tuple(int(s) for s in attrs.get("shape", ()))
+            if any(d < -1 for d in shape):
+                raise MXNetError(
+                    f"reshape shape {shape} uses mxnet special codes "
+                    "(-2/-3/-4) that ONNX Reshape cannot express")
             shp_name = f"{name}_shape"
             graph.initializer.append(_tensor(
                 shp_name, np.asarray(shape, np.int64), pb))

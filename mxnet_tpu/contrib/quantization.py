@@ -172,6 +172,10 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                         f"{layer.act._act_type}")
                 plan.append(("relu", None, None))
         elif isinstance(layer, nn.Activation):
+            if layer._act_type != "relu":
+                raise MXNetError(
+                    f"only relu activations quantize; got "
+                    f"{layer._act_type}")
             plan.append(("relu", None, None))
         elif isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D,
                                 nn.GlobalAvgPool2D)):

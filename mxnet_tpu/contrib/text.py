@@ -52,8 +52,9 @@ class Vocabulary:
         if counter is not None:
             pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
             taken = set(self._idx_to_token)
-            budget = most_freq_count - len(self._idx_to_token) \
-                if most_freq_count is not None else None
+            # reference semantics: the cap counts CORPUS tokens only —
+            # final len = most_freq_count + 1 (unk) + len(reserved)
+            budget = most_freq_count if most_freq_count is not None else None
             for tok, freq in pairs:
                 if freq < min_freq or tok in taken:
                     continue

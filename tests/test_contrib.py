@@ -215,8 +215,9 @@ def test_text_vocabulary():
     assert vocab.to_tokens([2, 0]) == ["d", "<unk>"]
     assert len(vocab) == 5
 
-    capped = text.Vocabulary(counter, most_freq_count=3)
-    assert len(capped) == 3  # <unk> + 2 most frequent
+    capped = text.Vocabulary(counter, most_freq_count=2)
+    assert len(capped) == 3  # <unk> + the 2 most frequent corpus tokens
+    assert capped.idx_to_token == ["<unk>", "d", "c"]
 
 
 def test_text_custom_embedding(tmp_path):

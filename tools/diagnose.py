@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Environment diagnostics (reference: ``tools/diagnose.py`` — prints
+platform/library/hardware info for bug reports)."""
+
+from __future__ import annotations
+
+import os
+import platform
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("machine      :", platform.machine())
+    print("----------Environment----------")
+    for k, v in sorted(os.environ.items()):
+        if k.startswith(("MXTPU_", "JAX_", "XLA_", "DMLC_", "TPU_")):
+            print(f"{k}={v}")
+    print("----------JAX / device Info----------")
+    try:
+        import jax
+
+        print("jax          :", jax.__version__)
+        print("backend      :", jax.default_backend())
+        for d in jax.devices():
+            print("device       :", d, "-", d.device_kind)
+        print("process      :", jax.process_index(), "/", jax.process_count())
+    except Exception as e:  # pragma: no cover
+        print("jax unavailable:", e)
+    print("----------mxnet_tpu Info----------")
+    try:
+        import mxnet_tpu as mx
+        from mxnet_tpu import runtime
+        from mxnet_tpu.ops.registry import all_ops
+
+        print("version      :", getattr(mx, "__version__", "dev"))
+        ops = all_ops()
+        uniq = len({id(o.fn) for o in ops.values()})
+        print("ops          :", len(ops), "names /", uniq, "unique")
+        feats = runtime.Features()
+        enabled = [name for name in dir(feats) if not name.startswith("_")]
+        print("features     :", ", ".join(sorted(enabled))[:200])
+    except Exception as e:  # pragma: no cover
+        print("mxnet_tpu import failed:", e)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Kill stray distributed training processes on this host (reference:
+``tools/kill-mxnet.py`` — cleans up after a crashed launcher run)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+
+
+def main():
+    prog = sys.argv[1] if len(sys.argv) > 1 else "dist_worker.py"
+    out = subprocess.run(["ps", "-eo", "pid,command"], capture_output=True,
+                         text=True).stdout
+    me = os.getpid()
+    killed = []
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if pid == me:
+            continue
+        if ("MXTPU_PROCESS_ID" in cmd or prog in cmd
+                or "launch.py" in cmd) and "python" in cmd:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append(pid)
+            except ProcessLookupError:
+                pass
+    print(f"killed {len(killed)} process(es): {killed}")
+
+
+if __name__ == "__main__":
+    main()
