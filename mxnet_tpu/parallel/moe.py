@@ -1,0 +1,100 @@
+"""Mixture-of-Experts with expert parallelism (P12).
+
+No reference counterpart (SURVEY.md §2.5 P12 — "does not exist in the
+reference"; previously a documented drop). TPU-native design: the
+classic mesh-tensorflow/GShard algorithm — top-1 gating with capacity,
+einsum dispatch/combine, experts sharded over an ``ep`` mesh axis inside
+``shard_map`` so each device runs only its local experts; tokens reach
+their expert's device via the dispatch einsum on locally-sharded expert
+tensors (XLA lowers the resharding to an all-to-all over ICI).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+
+def top1_routing(gate_logits, num_experts, capacity):
+    """Top-1 router with capacity (GShard): returns (dispatch (T,E,C),
+    combine (T,E,C), aux_loss). Tokens beyond an expert's capacity drop
+    (standard semantics)."""
+    T = gate_logits.shape[0]
+    probs = jax.nn.softmax(gate_logits, axis=-1)           # (T, E)
+    expert = jnp.argmax(probs, axis=-1)                    # (T,)
+    onehot = jax.nn.one_hot(expert, num_experts)           # (T, E)
+    # position of each token within its expert's queue (0-based; the
+    # onehot factor keeps non-selected experts from contributing)
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot      # (T, E)
+    pos_in_expert = jnp.sum(pos, axis=-1)                  # (T,)
+    keep = pos_in_expert < capacity
+    pos_oh = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity)
+    dispatch = onehot[:, :, None] * pos_oh[:, None, :] \
+        * keep[:, None, None]                              # (T, E, C)
+    gate_val = jnp.sum(probs * onehot, axis=-1)            # (T,)
+    combine = dispatch * gate_val[:, None, None]
+    # load-balance auxiliary loss (Shazeer et al.): E * <fraction, prob>
+    frac = jnp.mean(onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def init_moe_params(key, d_model, d_hidden, num_experts):
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale = 1.0 / jnp.sqrt(d_model)
+    return {
+        "gate": jax.random.normal(k1, (d_model, num_experts)) * scale,
+        "w1": jax.random.normal(k2, (num_experts, d_model, d_hidden))
+        * scale,
+        "w2": jax.random.normal(k3, (num_experts, d_hidden, d_model))
+        * (1.0 / jnp.sqrt(d_hidden)),
+    }
+
+
+def moe_apply(params, x, mesh=None, axis_name="ep", capacity_factor=1.5):
+    """MoE FFN over tokens x (T, d). Experts shard over ``axis_name``
+    when a mesh is given (expert parallelism); single-device otherwise.
+    Returns (out (T, d), aux_loss)."""
+    E = params["w1"].shape[0]
+    T, D = x.shape
+    capacity = int(max(1, (T / E) * capacity_factor))
+    gate_logits = x @ params["gate"]
+    dispatch, combine, aux = top1_routing(gate_logits, E, capacity)
+    expert_in = jnp.einsum("td,tec->ecd", x, dispatch)      # (E, C, d)
+
+    def run_experts(w1, w2, ein):
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", ein, w1))
+        return jnp.einsum("ech,ehd->ecd", h, w2)
+
+    if mesh is None:
+        expert_out = run_experts(params["w1"], params["w2"], expert_in)
+    else:
+        if E % mesh.shape[axis_name]:
+            raise MXNetError(
+                f"experts {E} must divide mesh axis {axis_name} "
+                f"({mesh.shape[axis_name]})")
+        from jax import shard_map
+
+        expert_out = shard_map(
+            run_experts, mesh=mesh,
+            in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+            out_specs=P(axis_name),
+        )(params["w1"], params["w2"], expert_in)
+    out = jnp.einsum("ecd,tec->td", expert_out, combine)
+    return out, aux
+
+
+def shard_moe_params(params, mesh, axis_name="ep"):
+    """Place expert tensors with the expert axis over ``ep``; the gate is
+    replicated."""
+    out = dict(params)
+    out["w1"] = jax.device_put(params["w1"],
+                               NamedSharding(mesh, P(axis_name)))
+    out["w2"] = jax.device_put(params["w2"],
+                               NamedSharding(mesh, P(axis_name)))
+    out["gate"] = jax.device_put(params["gate"], NamedSharding(mesh, P()))
+    return out

@@ -1,0 +1,141 @@
+"""Pipeline parallelism (P10): GPipe schedule over a ``pp`` mesh axis.
+
+No reference counterpart (SURVEY.md §2.5 P10 — "does not exist in the
+reference"; previously a documented drop). TPU-native design per the
+public scaling-book recipe: stages live on devices along the ``pp`` axis
+(stage parameters stacked on a leading axis, sharded over ``pp``);
+activations hop stage-to-stage with ``lax.ppermute`` riding ICI; the
+fill-drain (GPipe) schedule runs M microbatches in S + M - 1 ticks.
+
+Everything is pure JAX, so ``jax.grad`` differentiates straight through
+the schedule — the transpose of ``ppermute`` is the reverse permute, so
+the backward pass is automatically the reverse pipeline.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name="pp",
+                   num_microbatches=None):
+    """Apply ``S`` pipelined stages to ``x``.
+
+    stage_fn(params_one_stage, activation) -> activation (same shape);
+    stage_params: pytree whose leaves carry a leading stage axis of size
+    S (sharded over ``axis_name``); x: (B, ...) global batch, B divisible
+    by num_microbatches. Returns the (B, ...) output of the last stage.
+    """
+    S = mesh.shape[axis_name]
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != S:
+            raise MXNetError(
+                f"stage axis {leaf.shape[0]} != mesh {axis_name}={S}: "
+                "each device must hold exactly one stage")
+    M = num_microbatches or S
+    B = x.shape[0]
+    if B % M:
+        raise MXNetError(
+            f"num_microbatches {M} must divide the batch size {B}")
+    mb = B // M
+    xs = x.reshape(M, mb, *x.shape[1:])
+
+    def per_stage(params_local, xs_local):
+        # params_local: (1, ...) this device's stage slice
+        params_one = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        stage = lax.axis_index(axis_name)
+
+        def _vary(v):  # mark as varying over pp (shard_map vma check)
+            if hasattr(lax, "pcast"):
+                return lax.pcast(v, (axis_name,), to="varying")
+            return v  # pragma: no cover (older jax)
+
+        state = _vary(jnp.zeros_like(xs_local[0]))   # in-flight activation
+        outputs = _vary(jnp.zeros_like(xs_local))    # filled by last stage
+        fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        for t in range(M + S - 1):
+            # stage 0 ingests microbatch t; everyone else uses the state
+            # handed over from the previous stage
+            feed = xs_local[jnp.minimum(t, M - 1)]
+            inp = jnp.where(stage == 0, feed, state)
+            out = stage_fn(params_one, inp)
+            # last stage banks microbatch t-(S-1)
+            oidx = t - (S - 1)
+            live = (oidx >= 0) & (stage == S - 1)
+            banked = outputs.at[jnp.clip(oidx, 0, M - 1)].set(out)
+            outputs = jnp.where(live, banked, outputs)
+            # hand the activation to the next stage
+            state = lax.ppermute(out, axis_name, fwd)
+        # activations circulate back to stage 0 from the last hop; only
+        # the last stage's banked outputs matter — broadcast them so the
+        # (replicated) output spec is consistent
+        outputs = lax.psum(
+            jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs)),
+            axis_name)
+        return outputs
+
+    from jax import shard_map
+
+    spec_params = jax.tree_util.tree_map(
+        lambda _: P(axis_name), stage_params)
+    fn = shard_map(per_stage, mesh=mesh,
+                   in_specs=(spec_params, P()),
+                   out_specs=P())
+    ys = fn(stage_params, xs)
+    return ys.reshape(B, *x.shape[1:])
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree_per_stage, ...] -> one pytree with a leading stage axis."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def shard_stages(stacked, mesh, axis_name="pp"):
+    """Place stacked stage params with the stage axis over ``pp``."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            leaf, NamedSharding(mesh, P(axis_name))), stacked)
+
+
+class PipelineTrainStep:
+    """Pipelined training: loss/grads through the GPipe schedule.
+
+    >>> step = PipelineTrainStep(stage_fn, stage_params, mesh, loss_fn)
+    >>> loss = step(x, y, lr=0.1)
+    """
+
+    def __init__(self, stage_fn, stage_params, mesh, loss_fn,
+                 axis_name="pp", num_microbatches=None):
+        self._stage_fn = stage_fn
+        self._mesh = mesh
+        self._axis = axis_name
+        self._loss_fn = loss_fn
+        self._M = num_microbatches
+        self._params = shard_stages(stage_params, mesh, axis_name)
+
+        def train(params, x, y, lr):
+            def loss_of(p):
+                out = pipeline_apply(stage_fn, p, x, mesh, axis_name,
+                                     num_microbatches)
+                return loss_fn(out, y)
+
+            loss, grads = jax.value_and_grad(loss_of)(params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            return new_params, loss
+
+        self._train = jax.jit(train, donate_argnums=(0,))
+
+    def __call__(self, x, y, lr=0.01):
+        raw_x = x.data if hasattr(x, "data") else jnp.asarray(x)
+        raw_y = y.data if hasattr(y, "data") else jnp.asarray(y)
+        self._params, loss = self._train(self._params, raw_x, raw_y,
+                                         jnp.asarray(lr, jnp.float32))
+        return loss

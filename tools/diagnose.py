@@ -46,8 +46,9 @@ def main():
         uniq = len({id(o.fn) for o in ops.values()})
         print("ops          :", len(ops), "names /", uniq, "unique")
         feats = runtime.Features()
-        enabled = [name for name in dir(feats) if not name.startswith("_")]
-        print("features     :", ", ".join(sorted(enabled))[:200])
+        enabled = sorted(k for k, f in feats.items()
+                         if getattr(f, "enabled", False))
+        print("features     :", ", ".join(enabled)[:200])
     except Exception as e:  # pragma: no cover
         print("mxnet_tpu import failed:", e)
 

@@ -23,8 +23,10 @@ def main():
         pid, cmd = int(parts[0]), parts[1]
         if pid == me:
             continue
-        if ("MXTPU_PROCESS_ID" in cmd or prog in cmd
-                or "launch.py" in cmd) and "python" in cmd:
+        # NB: ps shows the command line, not the environment, so only
+        # script-name matching is possible (pass your worker script as
+        # argv[1] when it isn't the default)
+        if (prog in cmd or "launch.py" in cmd) and "python" in cmd:
             try:
                 os.kill(pid, signal.SIGKILL)
                 killed.append(pid)
