@@ -134,6 +134,15 @@ _FUNCS = [
     "bincount", "histogram", "digitize", "corrcoef", "cov", "convolve",
     "correlate", "gradient", "diff", "ediff1d", "trapezoid", "vander",
     "polyval", "real", "imag", "conj", "conjugate", "angle",
+    # round-3 breadth (auto-skipped when absent from jnp)
+    "divmod", "float_power", "frexp", "modf", "logaddexp", "logaddexp2",
+    "i0", "sinc", "isin", "in1d", "intersect1d", "union1d", "setdiff1d",
+    "histogram2d", "histogramdd", "bartlett", "blackman", "hamming",
+    "hanning", "kaiser", "nanmedian", "nanpercentile", "nanquantile",
+    "nancumprod", "put_along_axis", "select", "piecewise", "rollaxis",
+    "trim_zeros", "unwrap", "roots", "polyadd", "polyder", "polyfit",
+    "polyint", "polymul", "polysub", "diag_indices_from", "packbits",
+    "unpackbits", "real_if_close", "shares_memory",
 ]
 
 for _n in _FUNCS:
@@ -157,6 +166,42 @@ int64 = _onp.int64
 uint8 = _onp.uint8
 bool_ = _onp.bool_
 dtype = _onp.dtype
+
+# aliases / shims jnp spells differently
+if not hasattr(_THIS, "trapz") and hasattr(_THIS, "trapezoid"):
+    trapz = trapezoid  # noqa: F821 - numpy<2 name
+
+
+def msort(a):
+    """Sort along the first axis (legacy numpy msort)."""
+    return sort(a, axis=0)  # noqa: F821
+
+
+def fill_diagonal(a, val, wrap=False):
+    """numpy contract: fills ``a``'s diagonal IN PLACE (rebinding the
+    NDArray handle; jax buffers are immutable underneath) and returns
+    None, exactly like numpy — ported `fill_diagonal(w, 0); use(w)`
+    code keeps working."""
+    filled = _call_recorded(
+        lambda x, v: jnp.fill_diagonal(x, v, wrap=wrap, inplace=False),
+        "fill_diagonal", (a, val), {})
+    if hasattr(a, "_set_data"):
+        a._set_data(filled.data if hasattr(filled, "data") else filled)
+        return None
+    return filled  # raw-array input: no handle to mutate
+
+
+def put_along_axis(arr, indices, values, axis):
+    """numpy-signature put_along_axis (jnp defaults to inplace=True which
+    always raises); mutates NDArray inputs in place like numpy."""
+    placed = _call_recorded(
+        lambda a, i, v: jnp.put_along_axis(a, i, v, axis, inplace=False),
+        "put_along_axis", (arr, indices, values), {})
+    if hasattr(arr, "_set_data"):
+        arr._set_data(placed.data if hasattr(placed, "data") else placed)
+        return None
+    return placed
+
 
 from . import linalg  # noqa: E402,F401
 from . import random  # noqa: E402,F401

@@ -237,3 +237,40 @@ def test_npx_set_np_shape_semantics():
     p.initialize()
     p.shape = (5, 4)
     assert p.data().shape == (5, 4)
+
+
+def test_round3_breadth_functions():
+    """New round-3 np functions agree with numpy on representative calls."""
+    a = onp.array([3.0, 1.0, 2.0], onp.float32)
+    m = onp.array([[4.0, 1.0], [2.0, 3.0]], onp.float32)
+    z = onp.array([0., 1., 2., 0.], onp.float32)
+    b2 = onp.array([2.0, 5.0], onp.float32)
+    checks = [
+        (np.sinc(np.array(a)), onp.sinc(a)),
+        (np.i0(np.array(a)), onp.i0(a)),
+        (np.float_power(np.array(a), 2.0), onp.float_power(a, 2.0)),
+        (np.logaddexp2(np.array(a), np.array(a)), onp.logaddexp2(a, a)),
+        (np.nanmedian(np.array(a)), onp.nanmedian(a)),
+        (np.msort(np.array(m)), onp.sort(m, axis=0)),
+        (np.trim_zeros(np.array(z)), onp.trim_zeros(z)),
+        (np.union1d(np.array(a), np.array(b2)), onp.union1d(a, b2)),
+        (np.unwrap(np.array(a)), onp.unwrap(a)),
+    ]
+    for got, want in checks:
+        got_np = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+        onp.testing.assert_allclose(got_np, onp.asarray(want),
+                                    rtol=1e-5, atol=1e-6)
+    r = np.isin(np.array(a), np.array(onp.array([1.0, 9.0], onp.float32)))
+    onp.testing.assert_array_equal(r.asnumpy(), [False, True, False])
+
+    # in-place contracts (numpy semantics: mutate, return None)
+    w = np.array(m)
+    assert np.fill_diagonal(w, 9.0) is None
+    onp.testing.assert_allclose(w.asnumpy(),
+                                onp.array([[9., 1.], [2., 9.]], onp.float32))
+    w2 = np.zeros((3, 3))
+    idx = np.array(onp.array([[1], [0], [2]], onp.int32))
+    assert np.put_along_axis(w2, idx, 7.0, 1) is None
+    ref = onp.zeros((3, 3), onp.float32)
+    onp.put_along_axis(ref, onp.array([[1], [0], [2]]), 7.0, 1)
+    onp.testing.assert_allclose(w2.asnumpy(), ref)
