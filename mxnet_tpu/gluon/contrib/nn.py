@@ -71,3 +71,42 @@ class PixelShuffle2D(HybridBlock):
         x = F.reshape(x, shape=(n, c // (f1 * f2), f1, f2, h, w))
         x = F.transpose(x, axes=(0, 1, 4, 2, 5, 3))
         return F.reshape(x, shape=(n, c // (f1 * f2), h * f1, w * f2))
+
+
+class MoEDense(HybridBlock):
+    """Mixture-of-experts FFN layer over tokens (P12 at the Gluon level).
+
+    No reference counterpart (MoE does not exist in the reference —
+    SURVEY.md §2.5 P12); lowers to the ``_contrib_moe`` op (GShard top-1
+    routing with capacity + load-balance aux loss,
+    :mod:`mxnet_tpu.parallel.moe`). Input (B, T, d) or (T, d); returns
+    ``(out, aux_loss)`` — add ``aux_loss * coef`` to the objective.
+    With ``mesh=`` (an ``ep``-axis mesh) experts shard across devices.
+    """
+
+    def __init__(self, units, hidden_units, num_experts,
+                 capacity_factor=1.5, mesh=None, axis_name="ep",
+                 dtype="float32", weight_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._cf = capacity_factor
+        self._mesh = mesh
+        self._axis = axis_name
+        with self.name_scope():
+            self.gate = self.params.get(
+                "gate", shape=(units, num_experts), dtype=dtype,
+                init=weight_initializer)
+            self.w1 = self.params.get(
+                "w1", shape=(num_experts, units, hidden_units), dtype=dtype,
+                init=weight_initializer)
+            self.w2 = self.params.get(
+                "w2", shape=(num_experts, hidden_units, units), dtype=dtype,
+                init=weight_initializer)
+
+    def hybrid_forward(self, F, x, gate, w1, w2):
+        shape = x.shape
+        tokens = F.reshape(x, (-1, shape[-1]))
+        out, aux = F._contrib_moe(tokens, gate, w1, w2, mesh=self._mesh,
+                                  axis_name=self._axis,
+                                  capacity_factor=self._cf)
+        return F.reshape(out, (*shape[:-1], self._units)), aux

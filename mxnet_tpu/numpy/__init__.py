@@ -139,7 +139,7 @@ _FUNCS = [
     "i0", "sinc", "isin", "in1d", "intersect1d", "union1d", "setdiff1d",
     "histogram2d", "histogramdd", "bartlett", "blackman", "hamming",
     "hanning", "kaiser", "nanmedian", "nanpercentile", "nanquantile",
-    "nancumprod", "put_along_axis", "select", "piecewise", "rollaxis",
+    "nancumprod", "select", "piecewise", "rollaxis",
     "trim_zeros", "unwrap", "roots", "polyadd", "polyder", "polyfit",
     "polyint", "polymul", "polysub", "diag_indices_from", "packbits",
     "unpackbits", "real_if_close", "shares_memory",
@@ -167,6 +167,35 @@ uint8 = _onp.uint8
 bool_ = _onp.bool_
 dtype = _onp.dtype
 
+def _snapshot_lineage(a):
+    """Detach ``a``'s current value into a fresh handle that takes over
+    its tape identity: the producing node's out_arrays slot must point at
+    the snapshot, else the old node would keep claiming cotangents meant
+    for the post-mutation value (same object id)."""
+    snap = NDArray(a.data, ctx=a.ctx)
+    info = getattr(a, "_ag", None)
+    snap._ag = info
+    if info is not None:
+        node, k = info
+        node.out_arrays[k] = snap
+    return snap
+
+
+def _rebind_inplace(target, result):
+    """Give ``target`` the data AND the tape identity of ``result``:
+    cotangents are keyed by array object identity, so the recording
+    node's out_arrays entry must point at the surviving handle or the
+    node never receives a cotangent during backward."""
+    target._set_data(result.data if hasattr(result, "data") else result)
+    info = getattr(result, "_ag", None)
+    if info is not None:
+        node, k = info
+        node.out_arrays[k] = target
+        target._ag = (node, k)
+    else:
+        target._ag = None
+
+
 # aliases / shims jnp spells differently
 if not hasattr(_THIS, "trapz") and hasattr(_THIS, "trapezoid"):
     trapz = trapezoid  # noqa: F821 - numpy<2 name
@@ -182,11 +211,16 @@ def fill_diagonal(a, val, wrap=False):
     NDArray handle; jax buffers are immutable underneath) and returns
     None, exactly like numpy — ported `fill_diagonal(w, 0); use(w)`
     code keeps working."""
+    src = a
+    if hasattr(a, "_set_data"):
+        # record against a SNAPSHOT that takes over the pre-mutation
+        # tape identity (recording against `a` itself would cycle)
+        src = _snapshot_lineage(a)
     filled = _call_recorded(
         lambda x, v: jnp.fill_diagonal(x, v, wrap=wrap, inplace=False),
-        "fill_diagonal", (a, val), {})
+        "fill_diagonal", (src, val), {})
     if hasattr(a, "_set_data"):
-        a._set_data(filled.data if hasattr(filled, "data") else filled)
+        _rebind_inplace(a, filled)
         return None
     return filled  # raw-array input: no handle to mutate
 
@@ -194,11 +228,14 @@ def fill_diagonal(a, val, wrap=False):
 def put_along_axis(arr, indices, values, axis):
     """numpy-signature put_along_axis (jnp defaults to inplace=True which
     always raises); mutates NDArray inputs in place like numpy."""
+    src = arr
+    if hasattr(arr, "_set_data"):
+        src = _snapshot_lineage(arr)  # see fill_diagonal
     placed = _call_recorded(
         lambda a, i, v: jnp.put_along_axis(a, i, v, axis, inplace=False),
-        "put_along_axis", (arr, indices, values), {})
+        "put_along_axis", (src, indices, values), {})
     if hasattr(arr, "_set_data"):
-        arr._set_data(placed.data if hasattr(placed, "data") else placed)
+        _rebind_inplace(arr, placed)
         return None
     return placed
 

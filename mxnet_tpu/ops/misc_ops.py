@@ -238,3 +238,17 @@ def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None,
     return sample_generalized_negative_binomial(
         jnp.full(s, float(mu)), jnp.full(s, float(alpha)), shape=None,
         dtype=dtype)
+
+
+@register("_contrib_moe", aliases=("moe",), jit=False)
+def moe(tokens, gate, w1, w2, mesh=None, axis_name="ep",
+        capacity_factor=1.5):
+    """Mixture-of-experts FFN op (P12): top-1 GShard routing over
+    (T, d) tokens; returns (out (T, d), aux_loss). Lowered by
+    mxnet_tpu.parallel.moe; registered here so the nd/sym namespaces and
+    the autograd tape see it like any other op."""
+    from ..parallel.moe import moe_apply
+
+    return moe_apply({"gate": gate, "w1": w1, "w2": w2}, tokens,
+                     mesh=mesh, axis_name=axis_name,
+                     capacity_factor=capacity_factor)

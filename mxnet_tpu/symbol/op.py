@@ -22,6 +22,8 @@ def _num_outputs(opname, attrs):
         return len(attrs.get("indices", ())) + 1
     if opname == "topk" and attrs.get("ret_typ") == "both":
         return 2
+    if opname in ("_contrib_moe", "moe"):
+        return 2  # (out, aux_loss)
     return 1
 
 

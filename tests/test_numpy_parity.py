@@ -274,3 +274,35 @@ def test_round3_breadth_functions():
     ref = onp.zeros((3, 3), onp.float32)
     onp.put_along_axis(ref, onp.array([[1], [0], [2]]), 7.0, 1)
     onp.testing.assert_allclose(w2.asnumpy(), ref)
+
+
+def test_inplace_np_funcs_keep_tape_lineage():
+    """Review regression: fill_diagonal/put_along_axis must rewire _ag so
+    gradients through overwritten positions are zero."""
+    from mxnet_tpu import autograd
+
+    x = mx.nd.ones((3, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = np.multiply(np.array(x.asnumpy()) * 0 + 1, 2.0)  # fresh graph
+    # direct NDArray flow:
+    x2 = mx.nd.ones((3, 3))
+    x2.attach_grad()
+    with autograd.record():
+        y2 = x2 * 2
+        np.fill_diagonal(y2, 0.0)
+        s = y2.sum()
+    s.backward()
+    g = x2.grad.asnumpy()
+    onp.testing.assert_allclose(onp.diag(g), [0, 0, 0])
+    assert (g[onp.eye(3) == 0] == 2).all()
+
+    x3 = mx.nd.ones((3, 1))
+    x3.attach_grad()
+    with autograd.record():
+        y3 = x3 * 2
+        np.put_along_axis(y3, np.array(onp.array([[0], [0], [0]],
+                                                 onp.int32)), 0.0, 1)
+        s3 = y3.sum()
+    s3.backward()
+    onp.testing.assert_allclose(x3.grad.asnumpy(), onp.zeros((3, 1)))

@@ -182,3 +182,36 @@ def test_moe_trains_with_aux_loss():
         params, l = train(params, x, y)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.75, (losses[0], losses[-1])
+
+
+def test_gluon_moe_dense_layer():
+    """MoE through the Gluon surface: eager + hybridized + trained."""
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.contrib.nn import MoEDense
+
+    layer = MoEDense(units=8, hidden_units=16, num_experts=4,
+                     capacity_factor=4.0)
+    layer.initialize(init=mx.initializer.Normal(0.1))
+    x = mx.nd.random.normal(shape=(2, 6, 8))
+    out, aux = layer(x)
+    assert out.shape == (2, 6, 8)
+    assert np.isfinite(float(aux.asnumpy()))
+
+    eager = out.asnumpy()
+    layer.hybridize()
+    out2, aux2 = layer(x)
+    np.testing.assert_allclose(out2.asnumpy(), eager, rtol=1e-5, atol=1e-6)
+
+    # trains: grads reach gate AND experts through the tape
+    trainer = gluon.Trainer(layer.collect_params(), "adam",
+                            {"learning_rate": 5e-2})
+    y = mx.nd.random.normal(shape=(2, 6, 8))
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            o, aux = layer(x)
+            l = ((o - y) ** 2).mean() + 0.01 * aux
+        l.backward()
+        trainer.step(2)
+        losses.append(float(l.asnumpy()))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
