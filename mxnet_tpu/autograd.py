@@ -311,3 +311,86 @@ class Function:
             for k, o in enumerate(outs):
                 o._ag = (node, k)
         return outputs
+
+
+# --------------------------------------------------------------------------
+# recorded functional updates (shared by mx.np shims and NDArray setitem)
+# --------------------------------------------------------------------------
+
+
+def record_functional(jfn, args, kwargs, name, wrap=None):
+    """Run ``jfn(*args, **kwargs)`` (NDArrays allowed anywhere in the
+    pytree) with tape recording: the vjp is taken over the whole call.
+    Returns wrapped NDArray result(s); ``wrap`` overrides the result
+    wrapper (mx.np uses its tuple/namedtuple-preserving one)."""
+    import jax
+
+    from .ndarray.ndarray import NDArray, _wrap_result
+
+    if wrap is None:
+        wrap = lambda r: _wrap_result(r, None)  # noqa: E731
+
+    is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                 is_leaf=is_nd)
+    tracked = [i for i, l in enumerate(leaves)
+               if is_nd(l) and is_tracked(l)] if is_recording() else []
+
+    def rebuild(raws):
+        a2, k2 = jax.tree_util.tree_unflatten(treedef, raws)
+        return jfn(*a2, **k2)
+
+    raws = [l.data if is_nd(l) else l for l in leaves]
+    if not tracked:
+        return wrap(rebuild(raws))
+
+    def g(*t):
+        full = list(raws)
+        for i, v in zip(tracked, t):
+            full[i] = v
+        return rebuild(full)
+
+    res, vjp_fn = jax.vjp(g, *[leaves[i].data for i in tracked])
+    result = wrap(res)
+    outs = list(result) if isinstance(result, (list, tuple)) else [result]
+    node = TapeNode(vjp_fn, [leaves[i] for i in tracked], len(outs),
+                    name=name)
+    node.out_arrays = list(outs)
+    for k, o in enumerate(outs):
+        if isinstance(o, NDArray):
+            o._ag = (node, k)
+    return result
+
+
+def snapshot_lineage(a):
+    """Detach ``a``'s current value into a fresh handle that TAKES OVER
+    its tape identity (the producing node's out_arrays slot): required
+    before mutating ``a`` in place, else the old node keeps claiming
+    cotangents meant for the post-mutation value (cotangents are keyed
+    by array object identity)."""
+    from .ndarray.ndarray import NDArray
+
+    snap = NDArray(a.data, ctx=a.ctx)
+    info = getattr(a, "_ag", None)
+    snap._ag = info
+    if info is not None:
+        node, k = info
+        node.out_arrays[k] = snap
+    # leaves must STAY tracked: share the grad buffer so pre-mutation
+    # contributions still accumulate into a.grad
+    snap._grad = getattr(a, "_grad", None)
+    snap._grad_req = getattr(a, "_grad_req", "write")
+    return snap
+
+
+def rebind_inplace(target, result):
+    """Give ``target`` the data AND tape identity of ``result`` — the
+    second half of a recorded in-place update."""
+    target._set_data(result.data if hasattr(result, "data") else result)
+    info = getattr(result, "_ag", None)
+    if info is not None:
+        node, k = info
+        node.out_arrays[k] = target
+        target._ag = (node, k)
+    else:
+        target._ag = None

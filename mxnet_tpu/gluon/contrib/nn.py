@@ -104,9 +104,10 @@ class MoEDense(HybridBlock):
                 init=weight_initializer)
 
     def hybrid_forward(self, F, x, gate, w1, w2):
-        shape = x.shape
-        tokens = F.reshape(x, (-1, shape[-1]))
+        # symbolic-safe: the token dim equals self._units, so no
+        # x.shape access is needed (Symbol has no .shape)
+        tokens = F.reshape(x, (-1, self._units))
         out, aux = F._contrib_moe(tokens, gate, w1, w2, mesh=self._mesh,
                                   axis_name=self._axis,
                                   capacity_factor=self._cf)
-        return F.reshape(out, (*shape[:-1], self._units)), aux
+        return F.reshape_like(out, x), aux

@@ -43,41 +43,11 @@ def _wrap(r):
 def _call_recorded(jfn, name, args, kwargs):
     """Execute with tape recording so ``mx.np`` composes with autograd
     exactly like op dispatch (reference: every mx.np op registers a
-    gradient; here the vjp is taken over the whole call)."""
-    import jax
-
+    gradient; the shared machinery lives in autograd.record_functional)."""
     from .. import autograd
 
-    is_nd = lambda x: isinstance(x, NDArray)  # noqa: E731
-    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=is_nd)
-    tracked = [i for i, l in enumerate(leaves)
-               if is_nd(l) and autograd.is_tracked(l)] \
-        if autograd.is_recording() else []
-
-    def rebuild(raws):
-        a2, k2 = jax.tree_util.tree_unflatten(treedef, raws)
-        return jfn(*a2, **k2)
-
-    raws = [l.data if is_nd(l) else l for l in leaves]
-    if not tracked:
-        return _wrap(rebuild(raws))
-
-    def g(*t):
-        full = list(raws)
-        for i, v in zip(tracked, t):
-            full[i] = v
-        return rebuild(full)
-
-    res, vjp_fn = jax.vjp(g, *[leaves[i].data for i in tracked])
-    result = _wrap(res)
-    outs = list(result) if isinstance(result, (list, tuple)) else [result]
-    node = autograd.TapeNode(vjp_fn, [leaves[i] for i in tracked],
-                             len(outs), name=f"np.{name}")
-    node.out_arrays = list(outs)
-    for k, o in enumerate(outs):
-        if isinstance(o, NDArray):
-            o._ag = (node, k)
-    return result
+    return autograd.record_functional(jfn, args, kwargs, f"np.{name}",
+                                      wrap=_wrap)
 
 
 def _make(jfn, name):
@@ -167,33 +137,9 @@ uint8 = _onp.uint8
 bool_ = _onp.bool_
 dtype = _onp.dtype
 
-def _snapshot_lineage(a):
-    """Detach ``a``'s current value into a fresh handle that takes over
-    its tape identity: the producing node's out_arrays slot must point at
-    the snapshot, else the old node would keep claiming cotangents meant
-    for the post-mutation value (same object id)."""
-    snap = NDArray(a.data, ctx=a.ctx)
-    info = getattr(a, "_ag", None)
-    snap._ag = info
-    if info is not None:
-        node, k = info
-        node.out_arrays[k] = snap
-    return snap
-
-
-def _rebind_inplace(target, result):
-    """Give ``target`` the data AND the tape identity of ``result``:
-    cotangents are keyed by array object identity, so the recording
-    node's out_arrays entry must point at the surviving handle or the
-    node never receives a cotangent during backward."""
-    target._set_data(result.data if hasattr(result, "data") else result)
-    info = getattr(result, "_ag", None)
-    if info is not None:
-        node, k = info
-        node.out_arrays[k] = target
-        target._ag = (node, k)
-    else:
-        target._ag = None
+# the in-place lineage machinery is shared with NDArray.__setitem__
+from ..autograd import (rebind_inplace as _rebind_inplace,  # noqa: E402
+                        snapshot_lineage as _snapshot_lineage)
 
 
 # aliases / shims jnp spells differently
@@ -211,33 +157,47 @@ def fill_diagonal(a, val, wrap=False):
     NDArray handle; jax buffers are immutable underneath) and returns
     None, exactly like numpy — ported `fill_diagonal(w, 0); use(w)`
     code keeps working."""
-    src = a
-    if hasattr(a, "_set_data"):
+    from .. import autograd as _ag
+
+    fn = lambda x, v: jnp.fill_diagonal(x, v, wrap=wrap,  # noqa: E731
+                                        inplace=False)
+    if not hasattr(a, "_set_data"):
+        return _call_recorded(fn, "fill_diagonal", (a, val), {})
+    if _ag.is_recording() and (_ag.is_tracked(a)
+                               or (hasattr(val, "_set_data")
+                                   and _ag.is_tracked(val))):
         # record against a SNAPSHOT that takes over the pre-mutation
         # tape identity (recording against `a` itself would cycle)
         src = _snapshot_lineage(a)
-    filled = _call_recorded(
-        lambda x, v: jnp.fill_diagonal(x, v, wrap=wrap, inplace=False),
-        "fill_diagonal", (src, val), {})
-    if hasattr(a, "_set_data"):
-        _rebind_inplace(a, filled)
-        return None
-    return filled  # raw-array input: no handle to mutate
+        _rebind_inplace(a, _call_recorded(fn, "fill_diagonal",
+                                          (src, val), {}))
+    else:  # outside record: plain data rebind, lineage untouched
+        a._set_data(fn(a.data, val.data if hasattr(val, "data") else val))
+    return None
 
 
 def put_along_axis(arr, indices, values, axis):
     """numpy-signature put_along_axis (jnp defaults to inplace=True which
     always raises); mutates NDArray inputs in place like numpy."""
-    src = arr
-    if hasattr(arr, "_set_data"):
+    from .. import autograd as _ag
+
+    fn = lambda a, i, v: jnp.put_along_axis(a, i, v, axis,  # noqa: E731
+                                            inplace=False)
+    if not hasattr(arr, "_set_data"):
+        return _call_recorded(fn, "put_along_axis",
+                              (arr, indices, values), {})
+    if _ag.is_recording() and (_ag.is_tracked(arr)
+                               or (hasattr(values, "_set_data")
+                                   and _ag.is_tracked(values))):
         src = _snapshot_lineage(arr)  # see fill_diagonal
-    placed = _call_recorded(
-        lambda a, i, v: jnp.put_along_axis(a, i, v, axis, inplace=False),
-        "put_along_axis", (src, indices, values), {})
-    if hasattr(arr, "_set_data"):
-        _rebind_inplace(arr, placed)
-        return None
-    return placed
+        _rebind_inplace(arr, _call_recorded(
+            fn, "put_along_axis", (src, indices, values), {}))
+    else:
+        a_raw = arr.data
+        i_raw = indices.data if hasattr(indices, "data") else indices
+        v_raw = values.data if hasattr(values, "data") else values
+        arr._set_data(fn(a_raw, i_raw, v_raw))
+    return None
 
 
 from . import linalg  # noqa: E402,F401

@@ -168,3 +168,45 @@ def test_backward_through_mutation_snapshot():
     x *= 10  # mutate after record
     y.backward()
     assert_almost_equal(x.grad, np.array([4.0], np.float32))
+
+
+def test_setitem_gradient_flow():
+    """Recorded slice-assign (reference `_slice_assign` FGradient):
+    gradients are zeroed through overwritten base positions AND flow
+    into a tracked assigned value."""
+    x = mx.nd.ones((4,))
+    x.attach_grad()
+    v = mx.nd.array(np.array([5.0], np.float32))
+    v.attach_grad()
+    with autograd.record():
+        y = x * 3
+        y[1:2] = v * 2
+        s = (y * y).sum()
+    s.backward()
+    # y = [3, 2v, 3, 3]; ds/dx_i = 2*y_i*3 = 18 except overwritten idx -> 0
+    np.testing.assert_allclose(x.grad.asnumpy(), [18, 0, 18, 18])
+    # ds/dv = 2*(2v)*2 = 8v = 40
+    np.testing.assert_allclose(v.grad.asnumpy(), [40.0])
+
+
+def test_setitem_outside_record_unchanged():
+    x = mx.nd.zeros((3,))
+    x[1] = 7.0
+    np.testing.assert_allclose(x.asnumpy(), [0, 7, 0])
+
+
+def test_setitem_on_leaf_zeroes_overwritten_grad():
+    """Review regression: in-place assign on an attach_grad LEAF must
+    zero gradients through overwritten positions (snapshot keeps the
+    leaf's tracking)."""
+    a = mx.nd.ones((4,))
+    a.attach_grad()
+    v = mx.nd.array(np.array([5.0], np.float32))
+    v.attach_grad()
+    with autograd.record():
+        a[1:2] = v
+        s = (a * a).sum()
+    s.backward()
+    # a = [1, 5, 1, 1]; ds/da_i = 2*a_i except the overwritten slot -> 0
+    np.testing.assert_allclose(a.grad.asnumpy(), [2, 0, 2, 2])
+    np.testing.assert_allclose(v.grad.asnumpy(), [10.0])

@@ -281,11 +281,6 @@ def test_inplace_np_funcs_keep_tape_lineage():
     gradients through overwritten positions are zero."""
     from mxnet_tpu import autograd
 
-    x = mx.nd.ones((3, 3))
-    x.attach_grad()
-    with autograd.record():
-        y = np.multiply(np.array(x.asnumpy()) * 0 + 1, 2.0)  # fresh graph
-    # direct NDArray flow:
     x2 = mx.nd.ones((3, 3))
     x2.attach_grad()
     with autograd.record():
@@ -306,3 +301,19 @@ def test_inplace_np_funcs_keep_tape_lineage():
         s3 = y3.sum()
     s3.backward()
     onp.testing.assert_allclose(x3.grad.asnumpy(), onp.zeros((3, 1)))
+
+
+def test_inplace_np_outside_record_preserves_lineage():
+    """Review regression: mutating a tape-resident array OUTSIDE record
+    must not sever upstream gradients (pre-existing semantics)."""
+    from mxnet_tpu import autograd
+
+    x = mx.nd.ones((3, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        s = y.sum()
+    with autograd.pause():
+        np.fill_diagonal(y, 0.0)
+    s.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.full((3, 3), 2.0))
