@@ -93,13 +93,21 @@ def predict_mode():
 
 
 class TapeNode:
-    """One recorded op: holds the VJP closure and graph edges."""
+    """One recorded op: holds the VJP closure and graph edges.
 
-    __slots__ = ("vjp_fn", "inputs", "n_outputs", "out_arrays", "out_cts", "name", "_order")
+    ``input_slots`` snapshots each input's producing (node, k) AT RECORD
+    TIME: backward routes cotangents through these captured slots, never
+    through the live ``_ag`` pointers — so later in-place mutation of an
+    input handle (which rebinds its identity) cannot corrupt gradients
+    of already-recorded consumers."""
+
+    __slots__ = ("vjp_fn", "inputs", "input_slots", "n_outputs",
+                 "out_arrays", "out_cts", "name", "_order")
 
     def __init__(self, vjp_fn, inputs, n_outputs, name=""):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of NDArray handles (tracked inputs)
+        self.input_slots = [getattr(i, "_ag", None) for i in inputs]
         self.n_outputs = n_outputs
         self.out_cts = None  # filled during backward
         self.name = name
@@ -143,10 +151,9 @@ def _toposort(root_nodes):
             continue
         seen.add(id(node))
         stack.append((node, True))
-        for inp in node.inputs:
-            child = _node_of(inp)
-            if child is not None and id(child) not in seen:
-                stack.append((child, False))
+        for slot in node.input_slots:  # captured at record time
+            if slot is not None and id(slot[0]) not in seen:
+                stack.append((slot[0], False))
     return order  # children before parents
 
 
@@ -164,63 +171,96 @@ def backward(heads, head_grads=None, retain_graph: bool = False, train_mode: boo
     elif isinstance(head_grads, NDArray):
         head_grads = [head_grads]
 
-    # Seed cotangents keyed by array identity.
+    # Cotangents keyed by PRODUCER SLOT — ("n", id(node), k) for node
+    # outputs, ("g", id(grad_buffer)) for leaves. Keying by live array
+    # identity would break under in-place mutation (a rebound handle's
+    # id would collect cotangents meant for a different tape value), and
+    # keying leaves by the grad BUFFER unifies a mutated leaf with its
+    # pre-mutation snapshot (they share the buffer).
     cts = {}
+    leaf_meta = {}  # ("g", ...) key -> (grad_buffer, grad_req)
 
-    def _add_ct(arr, ct):
-        key = id(arr)
-        if key in cts:
-            cts[key] = (arr, cts[key][1] + ct)
-        else:
-            cts[key] = (arr, ct)
+    def _add(key, ct):
+        cts[key] = cts[key] + ct if key in cts else ct
+
+    def _leaf_key(arr):
+        key = ("g", id(arr._grad))
+        prev = leaf_meta.get(key)
+        req = getattr(arr, "_grad_req", "write")
+        if prev is None or (prev[1] == "null" and req != "null"):
+            leaf_meta[key] = (arr._grad, req)
+        return key
 
     roots = []
     for h, hg in zip(heads, head_grads):
-        node = _node_of(h)
-        if node is None and h._grad is None:
+        info = getattr(h, "_ag", None)
+        if info is None and h._grad is None:
             raise MXNetError(
                 "cannot differentiate a head that is not on the tape; "
                 "run inside autograd.record() and/or attach_grad()"
             )
         seed = hg.data if hg is not None else jnp.ones(h.shape, h.data.dtype)
-        _add_ct(h, seed)
-        if node is not None:
-            roots.append(node)
+        if info is not None:
+            _add(("n", id(info[0]), info[1]), seed)
+            roots.append(info[0])
+        else:
+            _add(_leaf_key(h), seed)
 
     order = _toposort(roots)
 
     # reverse topological: parents (later ops) first
     for node in reversed(order):
-        # gather output cotangents for this node
-        outs = node.out_arrays
         any_ct = False
         out_cts = []
-        for o in outs:
-            ent = cts.get(id(o))
-            if ent is None:
+        for k, o in enumerate(node.out_arrays):
+            ct = cts.get(("n", id(node), k))
+            if ct is None:
                 out_cts.append(jnp.zeros(o.shape, o.data.dtype))
             else:
-                out_cts.append(ent[1])
+                out_cts.append(ct)
                 any_ct = True
         if not any_ct or node.vjp_fn is None:
             continue
         ct_in = tuple(out_cts) if node.n_outputs > 1 else out_cts[0]
         in_cts = node.vjp_fn(ct_in)
-        for arr, g in zip(node.inputs, in_cts):
+        for arr, slot, g in zip(node.inputs, node.input_slots, in_cts):
             if g is None:
                 continue
-            _add_ct(arr, g)
+            if slot is not None:
+                _add(("n", id(slot[0]), slot[1]), g)
+            elif getattr(arr, "_grad", None) is not None:
+                _add(_leaf_key(arr), g)
         if not retain_graph:
             node.vjp_fn = None
 
-    # write into attached grad buffers
-    for _, (arr, ct) in cts.items():
-        if arr._grad is not None:
-            req = getattr(arr, "_grad_req", "write")
+    # intermediate attach_grad: outputs with grad buffers get their slot ct
+    for node in order:
+        for k, o in enumerate(node.out_arrays):
+            if getattr(o, "_grad", None) is None:
+                continue
+            if ("g", id(o._grad)) in leaf_meta:
+                # a mutated LEAF: its buffer belongs to the leaf path
+                # (shared with the pre-mutation snapshot) — writing the
+                # post-mutation slot ct here would double-count
+                continue
+            ct = cts.get(("n", id(node), k))
+            if ct is None:
+                continue
+            req = getattr(o, "_grad_req", "write")
             if req == "add":
-                arr._grad._set_data(arr._grad.data + ct)
+                o._grad._set_data(o._grad.data + ct)
             elif req != "null":
-                arr._grad._set_data(jnp.asarray(ct, arr._grad.data.dtype))
+                o._grad._set_data(jnp.asarray(ct, o._grad.data.dtype))
+
+    # leaves: one write per grad buffer
+    for key, (buf, req) in leaf_meta.items():
+        ct = cts.get(key)
+        if ct is None or req == "null":
+            continue
+        if req == "add":
+            buf._set_data(buf.data + ct)
+        else:
+            buf._set_data(jnp.asarray(ct, buf.data.dtype))
 
     if not retain_graph:
         for node in order:
@@ -394,3 +434,19 @@ def rebind_inplace(target, result):
         target._ag = (node, k)
     else:
         target._ag = None
+
+
+def record_inplace(target, jfn, args, name, tracked_extra=()):
+    """THE in-place-update protocol (shared by NDArray.__setitem__ and
+    the mx.np in-place shims): run ``jfn(base_raw, *args)`` functionally
+    and give ``target`` the result's data and tape identity, recording
+    when appropriate. ``tracked_extra``: arrays among ``args`` whose
+    tracking should also trigger recording."""
+    if is_recording() and (is_tracked(target)
+                           or any(is_tracked(a) for a in tracked_extra)):
+        snap = snapshot_lineage(target)
+        rebind_inplace(target,
+                       record_functional(jfn, (snap, *args), {}, name))
+    else:
+        raws = [a.data if hasattr(a, "data") else a for a in args]
+        target._set_data(jfn(target.data, *raws))

@@ -290,30 +290,20 @@ class NDArray:
         if isinstance(idx, tuple):
             idx = tuple(i.data if isinstance(i, NDArray) else i for i in idx)
         val_nd = value if isinstance(value, NDArray) else None
-        if autograd.is_recording() and (
-                autograd.is_tracked(self)
-                or (val_nd is not None and autograd.is_tracked(val_nd))):
-            # recorded slice-assign (reference: the `_slice_assign` op has
-            # FGradient): gradients flow into the assigned value and are
-            # zeroed through the overwritten base positions
-            snap = autograd.snapshot_lineage(self)
-            v = val_nd if val_nd is not None else value
-            if isinstance(v, (list, tuple, _np.ndarray)):
-                v = jnp.asarray(v, self.data.dtype)
+        v = val_nd if val_nd is not None else value
+        if isinstance(v, (list, tuple, _np.ndarray)):
+            v = jnp.asarray(v, self.data.dtype)
 
-            def assign(base, vv):
-                vv2 = vv.astype(base.dtype) if hasattr(vv, "astype") else vv
-                return base.at[idx].set(vv2)
+        def assign(base, vv):
+            vv2 = vv.astype(base.dtype) if hasattr(vv, "astype") else vv
+            return base.at[idx].set(vv2)
 
-            res = autograd.record_functional(assign, (snap, v), {},
-                                             "_slice_assign")
-            autograd.rebind_inplace(self, res)
-            return
-        if val_nd is not None:
-            value = val_nd.data
-        elif isinstance(value, (list, tuple, _np.ndarray)):
-            value = jnp.asarray(value, self.data.dtype)
-        self._set_data(self.data.at[idx].set(value))
+        # recorded slice-assign (reference: the `_slice_assign` op has
+        # FGradient): gradients flow into the assigned value and are
+        # zeroed through the overwritten base positions
+        autograd.record_inplace(
+            self, assign, (v,), "_slice_assign",
+            tracked_extra=(val_nd,) if val_nd is not None else ())
 
     # ------------------------------------------------------------------
     # operators (delegate to the op registry; methods attached in register.py)

@@ -163,16 +163,9 @@ def fill_diagonal(a, val, wrap=False):
                                         inplace=False)
     if not hasattr(a, "_set_data"):
         return _call_recorded(fn, "fill_diagonal", (a, val), {})
-    if _ag.is_recording() and (_ag.is_tracked(a)
-                               or (hasattr(val, "_set_data")
-                                   and _ag.is_tracked(val))):
-        # record against a SNAPSHOT that takes over the pre-mutation
-        # tape identity (recording against `a` itself would cycle)
-        src = _snapshot_lineage(a)
-        _rebind_inplace(a, _call_recorded(fn, "fill_diagonal",
-                                          (src, val), {}))
-    else:  # outside record: plain data rebind, lineage untouched
-        a._set_data(fn(a.data, val.data if hasattr(val, "data") else val))
+    tracked = (val,) if hasattr(val, "_set_data") else ()
+    _ag.record_inplace(a, fn, (val,), "np.fill_diagonal",
+                       tracked_extra=tracked)
     return None
 
 
@@ -186,17 +179,9 @@ def put_along_axis(arr, indices, values, axis):
     if not hasattr(arr, "_set_data"):
         return _call_recorded(fn, "put_along_axis",
                               (arr, indices, values), {})
-    if _ag.is_recording() and (_ag.is_tracked(arr)
-                               or (hasattr(values, "_set_data")
-                                   and _ag.is_tracked(values))):
-        src = _snapshot_lineage(arr)  # see fill_diagonal
-        _rebind_inplace(arr, _call_recorded(
-            fn, "put_along_axis", (src, indices, values), {}))
-    else:
-        a_raw = arr.data
-        i_raw = indices.data if hasattr(indices, "data") else indices
-        v_raw = values.data if hasattr(values, "data") else values
-        arr._set_data(fn(a_raw, i_raw, v_raw))
+    tracked = (values,) if hasattr(values, "_set_data") else ()
+    _ag.record_inplace(arr, fn, (indices, values), "np.put_along_axis",
+                       tracked_extra=tracked)
     return None
 
 

@@ -210,3 +210,30 @@ def test_setitem_on_leaf_zeroes_overwritten_grad():
     # a = [1, 5, 1, 1]; ds/da_i = 2*a_i except the overwritten slot -> 0
     np.testing.assert_allclose(a.grad.asnumpy(), [2, 0, 2, 2])
     np.testing.assert_allclose(v.grad.asnumpy(), [10.0])
+
+
+def test_setitem_preserves_pre_mutation_consumers():
+    """Review regression: consumers recorded BEFORE an in-place assign
+    must keep their gradients (cotangents route via record-time slots)."""
+    a = mx.nd.ones((4,))
+    a.attach_grad()
+    with autograd.record():
+        b = (a * 2).sum()
+        a[1:2] = 5.0
+    b.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [2, 2, 2, 2])
+
+
+def test_setitem_grad_req_add_no_double_count():
+    """Review regression: grad_req='add' on a mutated leaf must not
+    double-count via the shared grad buffer."""
+    a = mx.nd.ones((4,))
+    a.attach_grad(grad_req="add")
+    v = mx.nd.array(np.array([5.0], np.float32))
+    v.attach_grad()
+    with autograd.record():
+        a[1:2] = v
+        s = (a * a).sum()
+    s.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [2, 0, 2, 2])
+    np.testing.assert_allclose(v.grad.asnumpy(), [10.0])
