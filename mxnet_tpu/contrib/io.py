@@ -1,0 +1,51 @@
+"""``mx.contrib.io`` (reference: ``python/mxnet/contrib/io.py``):
+``DataLoaderIter`` — adapt a Gluon ``DataLoader`` to the legacy
+``DataIter`` protocol so Module-era code can consume Gluon datasets."""
+
+from __future__ import annotations
+
+from ..io.io import DataBatch, DataDesc, DataIter
+
+
+class DataLoaderIter(DataIter):
+    """Wrap ``gluon.data.DataLoader`` as a ``DataIter`` (reference:
+    ``contrib/io.py`` ``DataLoaderIter``). The loader must yield
+    fixed-size (data, label) batches — last_batch='discard' or
+    divisible dataset — because the legacy protocol advertises static
+    ``provide_data``/``provide_label`` shapes."""
+
+    def __init__(self, loader, data_name="data", label_name="softmax_label",
+                 dtype=None):
+        super().__init__(batch_size=getattr(loader, "_batch_sampler", None)
+                         and loader._batch_sampler._batch_size or 0)
+        self._loader = loader
+        self._iter = None
+        self._data_name = data_name
+        self._label_name = label_name
+        self._dtype = dtype
+        first = next(iter(loader))
+        data, label = first[0], (first[1] if len(first) > 1 else None)
+        self.batch_size = data.shape[0]
+        self.provide_data = [DataDesc(data_name, data.shape,
+                                      dtype or data.dtype)]
+        self.provide_label = ([DataDesc(label_name, label.shape,
+                                        dtype or label.dtype)]
+                              if label is not None else [])
+        self.reset()
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def next(self):
+        try:
+            batch = next(self._iter)
+        except StopIteration:
+            raise StopIteration
+        data, label = batch[0], (batch[1] if len(batch) > 1 else None)
+        if self._dtype is not None:
+            data = data.astype(self._dtype)
+            if label is not None:
+                label = label.astype(self._dtype)
+        return DataBatch(data=[data],
+                         label=[label] if label is not None else None,
+                         pad=0)

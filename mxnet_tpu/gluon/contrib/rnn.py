@@ -1,0 +1,275 @@
+"""Contrib recurrent cells (reference: ``gluon/contrib/rnn/conv_rnn_cell.py``
+and ``gluon/contrib/rnn/rnn_cell.py``): convolutional RNN/LSTM/GRU cells in
+1D/2D/3D, variational (per-sequence mask) dropout, and projected LSTM.
+
+TPU-first notes: all shapes are static — ``input_shape`` is required at
+construction exactly as in the reference, so the hidden state's spatial
+dims are known without deferred inference and the whole unrolled cell
+jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from ..rnn.rnn_cell import ModifierCell, RecurrentCell
+from ...base import MXNetError
+
+
+def _conv_out_shape(spatial, kernel, pad, dilate):
+    return tuple(
+        (s + 2 * p - d * (k - 1) - 1) + 1
+        for s, k, p, d in zip(spatial, kernel, pad, dilate))
+
+
+def _to_tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    """Shared conv-cell machinery: i2h conv over the input, h2h conv over
+    the hidden state (stride 1, 'same' padding so state shape is stable)."""
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, dims, activation, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._dims = dims
+        self._input_shape = tuple(input_shape)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = _to_tuple(i2h_kernel, dims)
+        self._h2h_kernel = _to_tuple(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            if k % 2 == 0:
+                raise MXNetError(
+                    "h2h_kernel must be odd so the state keeps its shape; "
+                    f"got {self._h2h_kernel}")
+        self._i2h_pad = _to_tuple(i2h_pad, dims)
+        self._i2h_dilate = _to_tuple(i2h_dilate, dims)
+        self._h2h_dilate = _to_tuple(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+        in_c, in_spatial = input_shape[0], tuple(input_shape[1:])
+        self._state_spatial = _conv_out_shape(
+            in_spatial, self._i2h_kernel, self._i2h_pad, self._i2h_dilate)
+        ng = self._ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(ng * hidden_channels, in_c) + self._i2h_kernel,
+                init=i2h_weight_initializer)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(ng * hidden_channels, hidden_channels)
+                + self._h2h_kernel,
+                init=h2h_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_channels,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_channels,),
+                init=h2h_bias_initializer)
+
+    @property
+    def _ngates(self):
+        raise NotImplementedError
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        return [{"shape": shape, "__layout__": "NC" + "DHW"[3 - self._dims:]}]
+
+    def _conv_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                      i2h_bias, h2h_bias):
+        ng = self._ngates
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel,
+                            num_filter=ng * self._hidden_channels,
+                            pad=self._i2h_pad, dilate=self._i2h_dilate)
+        h2h = F.Convolution(states[0], h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel,
+                            num_filter=ng * self._hidden_channels,
+                            pad=self._h2h_pad, dilate=self._h2h_dilate)
+        return i2h, h2h
+
+    def _act(self, F, x):
+        if self._activation in ("relu", "tanh", "sigmoid", "softrelu"):
+            return F.Activation(x, act_type=self._activation)
+        return getattr(F, self._activation)(x)
+
+
+class _ConvRNNCell(_BaseConvRNNCell):
+    _ngates = 1
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMCell(_BaseConvRNNCell):
+    _ngates = 4
+
+    def state_info(self, batch_size=0):
+        info = super().state_info(batch_size)
+        return info + [dict(info[0])]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        in_g, forget_g, in_t, out_g = F.split(gates, num_outputs=4, axis=1)
+        in_g = F.sigmoid(in_g)
+        forget_g = F.sigmoid(forget_g)
+        in_t = self._act(F, in_t)
+        out_g = F.sigmoid(out_g)
+        next_c = forget_g * states[1] + in_g * in_t
+        next_h = out_g * self._act(F, next_c)
+        return next_h, [next_h, next_c]
+
+
+class _ConvGRUCell(_BaseConvRNNCell):
+    _ngates = 3
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_forward(F, inputs, states, i2h_weight,
+                                      h2h_weight, i2h_bias, h2h_bias)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        cand = self._act(F, i2h_n + reset * h2h_n)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]
+
+
+def _make_conv_cell(base, dims, name, default_act):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel,
+                 h2h_kernel, i2h_pad=None, i2h_dilate=1, h2h_dilate=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 activation=default_act, prefix=None, params=None):
+        base.__init__(self, input_shape, hidden_channels, i2h_kernel,
+                      h2h_kernel, (0,) * dims if i2h_pad is None else i2h_pad,
+                      i2h_dilate, h2h_dilate, i2h_weight_initializer,
+                      h2h_weight_initializer, i2h_bias_initializer,
+                      h2h_bias_initializer, dims, activation, prefix, params)
+
+    return type(name, (base,), {"__init__": __init__})
+
+
+Conv1DRNNCell = _make_conv_cell(_ConvRNNCell, 1, "Conv1DRNNCell", "tanh")
+Conv2DRNNCell = _make_conv_cell(_ConvRNNCell, 2, "Conv2DRNNCell", "tanh")
+Conv3DRNNCell = _make_conv_cell(_ConvRNNCell, 3, "Conv3DRNNCell", "tanh")
+Conv1DLSTMCell = _make_conv_cell(_ConvLSTMCell, 1, "Conv1DLSTMCell", "tanh")
+Conv2DLSTMCell = _make_conv_cell(_ConvLSTMCell, 2, "Conv2DLSTMCell", "tanh")
+Conv3DLSTMCell = _make_conv_cell(_ConvLSTMCell, 3, "Conv3DLSTMCell", "tanh")
+Conv1DGRUCell = _make_conv_cell(_ConvGRUCell, 1, "Conv1DGRUCell", "tanh")
+Conv2DGRUCell = _make_conv_cell(_ConvGRUCell, 2, "Conv2DGRUCell", "tanh")
+Conv3DGRUCell = _make_conv_cell(_ConvGRUCell, 3, "Conv3DGRUCell", "tanh")
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Apply the SAME dropout mask at every time step (Gal & Ghahramani;
+    reference: ``gluon/contrib/rnn/rnn_cell.py`` ``VariationalDropoutCell``).
+    Masks are drawn once per sequence (cleared by ``reset()``)."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        super().__init__(base_cell)
+        self.drop_inputs = drop_inputs
+        self.drop_states = drop_states
+        self.drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop_"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _mask(self, F, name, data, p):
+        mask = getattr(self, name)
+        if mask is None:
+            mask = F.Dropout(F.ones_like(data), p=p)
+            setattr(self, name, mask)
+        return mask
+
+    def hybrid_forward(self, F, inputs, states):
+        if self.drop_inputs:
+            inputs = inputs * self._mask(F, "_input_mask", inputs,
+                                         self.drop_inputs)
+        if self.drop_states:
+            m = self._mask(F, "_state_mask", states[0], self.drop_states)
+            states = [states[0] * m] + list(states[1:])
+        out, states = self.base_cell(inputs, states)
+        if self.drop_outputs:
+            out = out * self._mask(F, "_output_mask", out, self.drop_outputs)
+        return out, states
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a hidden-state projection (LSTMP, Sak et al. 2014;
+    reference: ``gluon/contrib/rnn/rnn_cell.py`` ``LSTMPCell``). The cell
+    state has ``hidden_size`` channels while the recurrent/output state is
+    projected down to ``projection_size``."""
+
+    def __init__(self, hidden_size, projection_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        self._input_size = input_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_g, forget_g, in_t, out_g = F.split(gates, num_outputs=4, axis=1)
+        in_g = F.sigmoid(in_g)
+        forget_g = F.sigmoid(forget_g)
+        in_t = F.tanh(in_t)
+        out_g = F.sigmoid(out_g)
+        next_c = forget_g * states[1] + in_g * in_t
+        hidden = out_g * F.tanh(next_c)
+        next_r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                                  num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]

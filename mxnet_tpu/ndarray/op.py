@@ -135,3 +135,23 @@ batch_norm = BatchNorm
 from .ndarray import (  # noqa: E402,F401
     array, zeros, ones, full, arange, eye, linspace, concatenate,
 )
+
+
+def reset_arrays(*arrays, num_arrays=None, **kw):
+    """In-place zeroing of a tensor list (reference:
+    ``contrib/reset_arrays.cc`` — the op exists for its SIDE EFFECT of
+    clearing grad buffers, so the nd front-end rebinds each input to the
+    zeroed value instead of returning fresh arrays)."""
+    from .ndarray import NDArray
+
+    n = num_arrays if num_arrays is not None else len(arrays)
+    for a in arrays[:n]:
+        if isinstance(a, NDArray):
+            a._set_data(_jnp_zeros_like(a.data))
+    return None
+
+
+def _jnp_zeros_like(x):
+    import jax.numpy as jnp
+
+    return jnp.zeros_like(x)

@@ -137,11 +137,6 @@ uint8 = _onp.uint8
 bool_ = _onp.bool_
 dtype = _onp.dtype
 
-# the in-place lineage machinery is shared with NDArray.__setitem__
-from ..autograd import (rebind_inplace as _rebind_inplace,  # noqa: E402
-                        snapshot_lineage as _snapshot_lineage)
-
-
 # aliases / shims jnp spells differently
 if not hasattr(_THIS, "trapz") and hasattr(_THIS, "trapezoid"):
     trapz = trapezoid  # noqa: F821 - numpy<2 name

@@ -474,3 +474,42 @@ def getnnz(data, axis=None):
     if axis is None:
         return jnp.sum(data != 0).astype(jnp.int32)
     return jnp.sum(data != 0, axis=axis).astype(jnp.int32)
+
+
+def _sldwin_band_idx(T, w, dilation, symmetric):
+    """Band column indices (T, B) and validity for sliding-window attention."""
+    band = 2 * w + 1 if symmetric else w + 1
+    offs = jnp.arange(band) - (w if symmetric else w)  # [-w..w] or [-w..0]
+    rows = jnp.arange(T)[:, None]
+    cols = rows + offs[None, :] * dilation
+    valid = (cols >= 0) & (cols < T)
+    return jnp.clip(cols, 0, T - 1), valid
+
+
+@register("sldwin_atten_score", aliases=("_contrib_sldwin_atten_score",))
+def sldwin_atten_score(query, key, dilation=1, w=3, symmetric=True):
+    """Banded attention scores (reference: ``contrib/sldwin_atten*.cc``
+    ``_contrib_sldwin_atten_score`` — Longformer-style sparse attention).
+
+    query/key (BH, T, D) -> score (BH, T, band) where band = 2w+1
+    (symmetric) or w+1; score[., i, j] = <q_i, k_{i+(j-w)*dilation}>.
+    Out-of-range band slots are 0. Banded gather instead of the full
+    (T, T) matrix keeps HBM traffic O(T*w)."""
+    bh, T, _ = query.shape
+    cols, valid = _sldwin_band_idx(T, w, dilation, symmetric)
+    k_band = key[:, cols, :]                       # (BH, T, band, D)
+    score = jnp.einsum("btd,btjd->btj", query, k_band)
+    return jnp.where(valid[None], score, 0.0).astype(query.dtype)
+
+
+@register("sldwin_atten_context", aliases=("_contrib_sldwin_atten_context",))
+def sldwin_atten_context(score, value, dilation=1, w=3, symmetric=True):
+    """Contract banded scores with values (reference:
+    ``_contrib_sldwin_atten_context``): score (BH, T, band) x value
+    (BH, T, D) -> (BH, T, D), the inverse gather of
+    ``sldwin_atten_score``."""
+    bh, T, D = value.shape
+    cols, valid = _sldwin_band_idx(T, w, dilation, symmetric)
+    v_band = value[:, cols, :]                     # (BH, T, band, D)
+    s = jnp.where(valid[None], score, 0.0)
+    return jnp.einsum("btj,btjd->btd", s, v_band).astype(value.dtype)

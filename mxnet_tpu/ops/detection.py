@@ -295,3 +295,100 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     if output_score:
         return rois, scores.reshape(-1, 1)
     return rois
+
+
+@register("box_encode", aliases=("_contrib_box_encode",))
+def box_encode(samples, matches, anchors, refs,
+               means=(0.0, 0.0, 0.0, 0.0), stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched ground-truth boxes into normalized regression targets
+    (reference: ``src/operator/contrib/bounding_box.cc`` ``_contrib_box_encode``).
+
+    samples (B,N) +1 matched / otherwise, matches (B,N) gt index,
+    anchors (B,N,4) corners, refs (B,M,4) corners -> (targets (B,N,4),
+    masks (B,N,4)). Targets are center-form deltas, (delta - mean)/std.
+    """
+    means = jnp.asarray(means, anchors.dtype)
+    stds = jnp.asarray(stds, anchors.dtype)
+
+    def one(sample, match, anc, ref):
+        g = ref[jnp.clip(match.astype(jnp.int32), 0, ref.shape[0] - 1)]
+        acx, acy, aw, ah = _corner_to_center(anc)
+        gcx, gcy, gw, gh = _corner_to_center(g)
+        aw = jnp.maximum(aw, 1e-12)
+        ah = jnp.maximum(ah, 1e-12)
+        t0 = ((gcx - acx) / aw - means[0]) / stds[0]
+        t1 = ((gcy - acy) / ah - means[1]) / stds[1]
+        t2 = (jnp.log(jnp.maximum(gw, 1e-12) / aw) - means[2]) / stds[2]
+        t3 = (jnp.log(jnp.maximum(gh, 1e-12) / ah) - means[3]) / stds[3]
+        t = jnp.stack([t0, t1, t2, t3], axis=-1)
+        m = (sample > 0.5).astype(anc.dtype)[:, None]
+        return t * m, jnp.broadcast_to(m, t.shape)
+
+    return jax.vmap(one)(samples, matches, anchors, refs)
+
+
+@register("box_decode", aliases=("_contrib_box_decode",))
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):
+    """Decode regression deltas back to corner boxes (reference:
+    ``bounding_box.cc`` ``_contrib_box_decode``). data (B,N,4) deltas,
+    anchors (1,N,4) or (B,N,4) in ``format`` ('corner'|'center')."""
+    a = jnp.asarray(anchors, data.dtype)
+    if format == "corner":
+        acx, acy, aw, ah = _corner_to_center(a)
+    else:
+        acx, acy, aw, ah = (a[..., 0], a[..., 1], a[..., 2], a[..., 3])
+    dx = data[..., 0] * std0
+    dy = data[..., 1] * std1
+    dw = data[..., 2] * std2
+    dh = data[..., 3] * std3
+    if clip is not None and clip > 0:
+        dw = jnp.minimum(dw, clip)
+        dh = jnp.minimum(dh, clip)
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    out = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                    axis=-1)
+    return jnp.broadcast_to(out, data.shape[:-1] + (4,))
+
+
+@register("bipartite_matching", aliases=("_contrib_bipartite_matching",))
+def bipartite_matching(data, is_ascend=False, threshold=0.5, topk=-1):
+    """Greedy bipartite matching over a (B,N,M) score matrix (reference:
+    ``bounding_box.cc`` ``_contrib_bipartite_matching``): repeatedly take
+    the globally best unmatched (row, col) pair until scores cross
+    ``threshold`` (or ``topk`` pairs matched). Returns (row_match (B,N)
+    col index or -1, col_match (B,M) row index or -1).
+
+    TPU-first: a fixed min(N,M)-trip ``fori_loop`` over an argmax of the
+    masked matrix — no host loop, static shapes throughout.
+    """
+    b, n, m = data.shape
+    trips = min(n, m) if topk is None or topk <= 0 else min(topk, n, m)
+    sign = -1.0 if is_ascend else 1.0
+    neg = -jnp.inf
+
+    def one(mat):
+        score = sign * mat.astype(jnp.float32)
+        thr = sign * jnp.float32(threshold)
+
+        def body(_, carry):
+            s, rowm, colm = carry
+            flat = jnp.argmax(s)
+            i, j = flat // m, flat % m
+            best = s[i, j]
+            ok = best >= thr
+            rowm = jnp.where(ok, rowm.at[i].set(j.astype(jnp.float32)), rowm)
+            colm = jnp.where(ok, colm.at[j].set(i.astype(jnp.float32)), colm)
+            s = jnp.where(ok, s.at[i, :].set(neg).at[:, j].set(neg), s)
+            return s, rowm, colm
+
+        rowm = jnp.full((n,), -1.0, jnp.float32)
+        colm = jnp.full((m,), -1.0, jnp.float32)
+        _, rowm, colm = lax.fori_loop(0, trips, body, (score, rowm, colm))
+        return rowm, colm
+
+    rows, cols = jax.vmap(one)(data)
+    return rows.astype(data.dtype), cols.astype(data.dtype)

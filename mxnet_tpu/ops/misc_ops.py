@@ -188,6 +188,19 @@ def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return _raw(_rand.poisson(lam, _shape_tuple(shape), dtype))
 
 
+@register("_random_gamma", aliases=("random_gamma",), jit=False)
+def random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None,
+                 **kw):
+    """Scalar-attr gamma sampling (reference: ``sample_op.cc``
+    ``_random_gamma``): ``shape`` IS the output shape (unlike
+    ``sample_gamma``, whose output is params.shape + shape)."""
+    from .random_ops import sample_gamma
+
+    s = _shape_tuple(shape)
+    return sample_gamma(jnp.full(s, float(alpha)), jnp.full(s, float(beta)),
+                        shape=None, dtype=dtype)
+
+
 @register("randint", aliases=("_random_randint", "random_randint"),
           jit=False)
 def randint(low, high=None, shape=None, dtype="int32", ctx=None, **kw):
@@ -252,3 +265,122 @@ def moe(tokens, gate, w1, w2, mesh=None, axis_name="ep",
     return moe_apply({"gate": gate, "w1": w1, "w2": w2}, tokens,
                      mesh=mesh, axis_name=axis_name,
                      capacity_factor=capacity_factor)
+
+
+# ---------------------------------------------------------------------------
+# round-3 breadth: AMP finiteness checks, grad zeroing, AdamW family,
+# legacy-name aliases
+# ---------------------------------------------------------------------------
+
+
+@register("all_finite")
+def all_finite(data, init_output=True):
+    """1 iff every element is finite (reference: ``contrib/all_finite.cc``
+    ``all_finite`` — the AMP dynamic-loss-scaling overflow check)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape((1,))
+
+
+@register("multi_all_finite", jit=False)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """AND of ``all_finite`` across a tensor list (reference:
+    ``multi_all_finite``): one fused reduction instead of per-tensor
+    host syncs."""
+    ok = jnp.array(True)
+    n = num_arrays if num_arrays is not None else len(arrays)
+    for a in arrays[:n]:
+        ok = ok & jnp.isfinite(a).all()
+    return ok.astype(jnp.float32).reshape((1,))
+
+
+@register("reset_arrays", jit=False)
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero a list of arrays in one call (reference:
+    ``contrib/reset_arrays.cc`` — the grad-zeroing fast path)."""
+    n = num_arrays if num_arrays is not None else len(arrays)
+    return tuple(jnp.zeros_like(a) for a in arrays[:n])
+
+
+@register("adamw_update", aliases=("_adamw_update", "_contrib_adamw_update"))
+def adamw_update(weight, grad, mean, var, rescale_grad, lr=0.001, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, eta=1.0,
+                 clip_gradient=-1.0):
+    """AdamW with decoupled weight decay (reference:
+    ``contrib/adamw.cc`` ``_adamw_update``; Loshchilov & Hutter). NOTE the
+    reference passes ``rescale_grad`` as a TENSOR so the loss scale can
+    change without recompiling — kept here (it is a traced operand)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_new = beta1 * mean + (1 - beta1) * g
+    var_new = beta2 * var + (1 - beta2) * g * g
+    w_new = weight - eta * (lr * mean_new / (jnp.sqrt(var_new) + epsilon)
+                            + wd * weight)
+    return w_new, mean_new, var_new
+
+
+@register("mp_adamw_update",
+          aliases=("_mp_adamw_update", "_contrib_mp_adamw_update"))
+def mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad,
+                    lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                    eta=1.0, clip_gradient=-1.0):
+    w32, m2, v2 = adamw_update(weight32, grad.astype(jnp.float32), mean, var,
+                               rescale_grad, lr=lr, beta1=beta1, beta2=beta2,
+                               epsilon=epsilon, wd=wd, eta=eta,
+                               clip_gradient=clip_gradient)
+    return w32.astype(weight.dtype), m2, v2, w32
+
+
+@register("multi_adamw_update", jit=False)
+def multi_adamw_update(*arrays, lrs=None, wds=None, etas=None,
+                       rescale_grad=1.0, beta1=0.9, beta2=0.999,
+                       epsilon=1e-8, clip_gradient=-1.0, num_tensors=None):
+    """Multi-tensor AdamW (reference: ``_multi_adamw_update``):
+    interleaved (w, g, mean, var) x n."""
+    from .optimizer_ops import _split_interleaved
+
+    n = num_tensors if num_tensors is not None else len(arrays) // 4
+    rg = jnp.asarray(rescale_grad)
+    outs = []
+    for i, (w, g, m, v) in enumerate(_split_interleaved(arrays, n, 4)):
+        w2, m2, v2 = adamw_update(w, g, m, v, rg, lr=lrs[i], wd=wds[i],
+                                  eta=(etas[i] if etas else 1.0),
+                                  beta1=beta1, beta2=beta2, epsilon=epsilon,
+                                  clip_gradient=clip_gradient)
+        outs.extend([w2, m2, v2])
+    return tuple(outs)
+
+
+@register("multi_mp_adamw_update", jit=False)
+def multi_mp_adamw_update(*arrays, lrs=None, wds=None, etas=None,
+                          rescale_grad=1.0, beta1=0.9, beta2=0.999,
+                          epsilon=1e-8, clip_gradient=-1.0,
+                          num_tensors=None):
+    from .optimizer_ops import _split_interleaved
+
+    n = num_tensors if num_tensors is not None else len(arrays) // 5
+    rg = jnp.asarray(rescale_grad)
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_split_interleaved(arrays, n, 5)):
+        w2, m2, v2, w32n = mp_adamw_update(
+            w, g, m, v, w32, rg, lr=lrs[i], wd=wds[i],
+            eta=(etas[i] if etas else 1.0), beta1=beta1, beta2=beta2,
+            epsilon=epsilon, clip_gradient=clip_gradient)
+        outs.extend([w2, m2, v2, w32n])
+    return tuple(outs)
+
+
+def _alias_existing(new_names, existing):
+    opdef = registry_get(existing)
+    for n in new_names:
+        _OPS_DICT[n] = opdef
+
+
+# legacy `_v1` layer names and numpy-style spellings are the same kernels
+from .registry import _OPS as _OPS_DICT  # noqa: E402
+from .registry import get as registry_get  # noqa: E402
+
+_alias_existing(("BatchNorm_v1",), "BatchNorm")
+_alias_existing(("Convolution_v1",), "Convolution")
+_alias_existing(("Pooling_v1",), "Pooling")
+_alias_existing(("broadcast_plus",), "broadcast_add")
+_alias_existing(("broadcast_minus",), "broadcast_sub")

@@ -464,3 +464,20 @@ _svm_core.defvjp(_svm_fwd, _svm_bwd)
 def svm_output(data, label, margin=1.0, regularization_coef=1.0,
                use_linear=False):
     return _svm_core(data, label, margin, regularization_coef, use_linear)
+
+
+@register("Crop", aliases=("crop_like",))
+def Crop(data, crop_like=None, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Legacy spatial crop (reference: ``src/operator/crop.cc`` ``Crop``):
+    crop NCHW ``data`` to the spatial size of ``crop_like`` (or explicit
+    ``h_w``), at ``offset`` or centered. Static sizes -> a plain slice."""
+    n, c, h, w = data.shape
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]

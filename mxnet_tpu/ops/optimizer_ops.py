@@ -387,3 +387,59 @@ def preloaded_multi_mp_sgd_mom_update(*arrays, momentum=0.0,
                                          clip_gradient=clip_gradient)
         outs.extend([w2, m2, w32n])
     return tuple(outs)
+
+
+def _lamb_one(w, g, m, v, lr, wd, t, beta1, beta2, epsilon, bias_correction,
+              rescale_grad, clip_gradient, lower_bound, upper_bound):
+    direction, m2, v2 = lamb_update_phase1(
+        w, g, m, v, beta1=beta1, beta2=beta2, epsilon=epsilon, t=t,
+        bias_correction=bias_correction, wd=wd, rescale_grad=rescale_grad,
+        clip_gradient=clip_gradient)
+    r1 = jnp.linalg.norm(w)
+    r2 = jnp.linalg.norm(direction)
+    w2 = lamb_update_phase2(w, direction, r1, r2, lr,
+                            lower_bound=lower_bound, upper_bound=upper_bound)
+    return w2, m2, v2
+
+
+@register("multi_lamb_update", jit=False)
+def multi_lamb_update(*arrays, step_count=(), learning_rates=None, wds=None,
+                      beta1=0.9, beta2=0.999, epsilon=1e-6,
+                      lower_bound=-1.0, upper_bound=-1.0,
+                      bias_correction=True, rescale_grad=1.0,
+                      clip_gradient=-1.0, num_tensors=None):
+    """Multi-tensor LAMB (reference: ``contrib/multi_lamb.cc``
+    ``_multi_lamb_update``): interleaved (w, g, mean, var) x n plus
+    per-tensor ``learning_rates``/``wds``/``step_count`` attrs; returns
+    interleaved (w2, mean2, var2) x n."""
+    n = num_tensors if num_tensors is not None else len(arrays) // 4
+    outs = []
+    for i, (w, g, m, v) in enumerate(_split_interleaved(arrays, n, 4)):
+        t = step_count[i] if i < len(step_count) else 1
+        w2, m2, v2 = _lamb_one(
+            w, g, m, v, learning_rates[i], wds[i], t, beta1, beta2, epsilon,
+            bias_correction, rescale_grad, clip_gradient,
+            lower_bound, upper_bound)
+        outs.extend([w2, m2, v2])
+    return tuple(outs)
+
+
+@register("multi_mp_lamb_update", jit=False)
+def multi_mp_lamb_update(*arrays, step_count=(), learning_rates=None,
+                         wds=None, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                         lower_bound=-1.0, upper_bound=-1.0,
+                         bias_correction=True, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_tensors=None):
+    """Multi-tensor multi-precision LAMB (``_multi_mp_lamb_update``):
+    interleaved (w, g, mean, var, w32) x n; math in fp32 master weights,
+    returns (w2, mean2, var2, w32_2) x n."""
+    n = num_tensors if num_tensors is not None else len(arrays) // 5
+    outs = []
+    for i, (w, g, m, v, w32) in enumerate(_split_interleaved(arrays, n, 5)):
+        t = step_count[i] if i < len(step_count) else 1
+        w32n, m2, v2 = _lamb_one(
+            w32, g.astype(jnp.float32), m, v, learning_rates[i], wds[i], t,
+            beta1, beta2, epsilon, bias_correction, rescale_grad,
+            clip_gradient, lower_bound, upper_bound)
+        outs.extend([w32n.astype(w.dtype), m2, v2, w32n])
+    return tuple(outs)

@@ -1,0 +1,80 @@
+"""contrib.svrg_optimization + contrib.io tests (reference:
+tests/python/unittest/test_contrib_svrg_module.py, contrib/io.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.contrib.io import DataLoaderIter
+from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+sym = mx.sym
+
+
+def _lin_problem(n=40, batch=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 3).astype(np.float32)
+    w = np.array([[1.5], [-2.0], [0.5]], np.float32)
+    Y = X @ w
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch, label_name="lin_label")
+    data = sym.var("data")
+    net = sym.FullyConnected(data, sym.var("fc_weight"), sym.var("fc_bias"),
+                             num_hidden=1, name="fc")
+    out = sym.LinearRegressionOutput(net, sym.var("lin_label"), name="lin")
+    return it, out, w
+
+
+def test_svrg_module_converges():
+    it, out, w = _lin_problem()
+    mod = SVRGModule(out, label_names=("lin_label",), update_freq=2)
+    mod.fit(it, num_epoch=30, optimizer_params=(("learning_rate", 0.5),),
+            eval_metric="mse")
+    arg, _ = mod.get_params()
+    got = arg["fc_weight"].asnumpy().ravel()
+    assert np.max(np.abs(got - w.ravel())) < 0.25, got
+
+
+def test_svrg_full_grads_and_correction():
+    it, out, _ = _lin_problem()
+    mod = SVRGModule(out, label_names=("lin_label",), update_freq=1)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(optimizer_params=(("learning_rate", 0.1),))
+    mod.update_full_grads(it)
+    assert "fc_weight" in mod._full_grads
+    # snapshot grads at snapshot weights equal current grads before any
+    # update -> corrected grad == full grad on the first step
+    it.reset()
+    batch = next(iter(it))
+    mod.forward_backward(batch)
+    g = mod._exec.grad_dict["fc_weight"].asnumpy()
+    g_aux = mod._mod_aux._exec.grad_dict["fc_weight"].asnumpy()
+    assert np.allclose(g, g_aux, atol=1e-5)
+    # after an update the weights diverge from the snapshot
+    mod.update()
+    mod.forward_backward(batch)
+    g2 = mod._exec.grad_dict["fc_weight"].asnumpy()
+    g2_aux = mod._mod_aux._exec.grad_dict["fc_weight"].asnumpy()
+    assert not np.allclose(g2, g2_aux, atol=1e-7)
+
+
+def test_dataloader_iter():
+    ds = gluon.data.ArrayDataset(mx.nd.random.uniform(shape=(20, 4)),
+                                 mx.nd.arange(20))
+    loader = gluon.data.DataLoader(ds, batch_size=5)
+    it = DataLoaderIter(loader)
+    assert it.batch_size == 5
+    assert it.provide_data[0].shape == (5, 4)
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (5, 4)
+    assert batches[0].label[0].shape == (5,)
+    it.reset()
+    assert len(list(it)) == 4
+    # Module can consume it directly
+    data = sym.var("data")
+    fc = sym.FullyConnected(data, sym.var("w"), sym.var("b"), num_hidden=3)
+    out = sym.SoftmaxOutput(fc, sym.var("softmax_label"))
+    mod = mx.mod.Module(out)
+    it.reset()
+    mod.fit(it, num_epoch=1, optimizer_params=(("learning_rate", 0.01),))

@@ -120,3 +120,82 @@ def test_hybridized_lstm():
     layer.hybridize()
     out = layer(x).asnumpy()
     np.testing.assert_allclose(ref, out, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# contrib cells (reference: gluon/contrib/rnn/)
+# ---------------------------------------------------------------------------
+
+
+def test_contrib_conv_cells():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cases = [
+        (crnn.Conv1DRNNCell, 1, 1), (crnn.Conv1DLSTMCell, 1, 2),
+        (crnn.Conv1DGRUCell, 1, 1), (crnn.Conv2DRNNCell, 2, 1),
+        (crnn.Conv2DLSTMCell, 2, 2), (crnn.Conv2DGRUCell, 2, 1),
+        (crnn.Conv3DLSTMCell, 3, 2),
+    ]
+    for Cell, dims, nstates in cases:
+        ishape = (3,) + (6,) * dims
+        cell = Cell(input_shape=ishape, hidden_channels=4,
+                    i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize()
+        x = mx.nd.random.uniform(shape=(2,) + ishape)
+        out, states = cell(x, cell.begin_state(2))
+        assert out.shape == (2, 4) + ishape[1:], Cell.__name__
+        assert len(states) == nstates
+        outs, _ = cell.unroll(3, mx.nd.random.uniform(shape=(2, 3) + ishape),
+                              merge_outputs=True)
+        assert outs.shape == (2, 3, 4) + ishape[1:]
+
+
+def test_contrib_conv_lstm_state_shape_mismatch_guard():
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    with _pytest.raises(MXNetError):
+        crnn.Conv2DLSTMCell(input_shape=(3, 6, 6), hidden_channels=4,
+                            i2h_kernel=3, h2h_kernel=2)
+
+
+def test_contrib_lstmp_cell():
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    cell = crnn.LSTMPCell(16, projection_size=5)
+    cell.initialize()
+    out, states = cell(mx.nd.random.uniform(shape=(4, 10)),
+                       cell.begin_state(4))
+    assert out.shape == (4, 5)
+    assert states[0].shape == (4, 5) and states[1].shape == (4, 16)
+    outs, _ = cell.unroll(3, mx.nd.random.uniform(shape=(4, 3, 10)),
+                          merge_outputs=True)
+    assert outs.shape == (4, 3, 5)
+
+
+def test_contrib_variational_dropout_cell():
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib import rnn as crnn
+
+    base = gluon.rnn.LSTMCell(8, input_size=8)
+    cell = crnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                       drop_outputs=0.5)
+    cell.initialize()
+    x = mx.nd.ones((2, 8))
+    with autograd.record():
+        _, s = cell(x, cell.begin_state(2))
+        _ = cell(x, s)
+    mask1 = cell._output_mask.asnumpy()
+    with autograd.record():
+        _ = cell(x, s)
+    # same mask reused across steps of one sequence
+    assert np.allclose(cell._output_mask.asnumpy(), mask1)
+    cell.reset()
+    assert cell._output_mask is None
+    # eval mode: dropout is identity, output deterministic
+    o1, _ = cell(x, cell.begin_state(2))
+    cell.reset()
+    o2, _ = cell(x, cell.begin_state(2))
+    assert np.allclose(o1.asnumpy(), o2.asnumpy())

@@ -558,3 +558,69 @@ def test_sldwin_mask_dilation():
                                      w=1).asnumpy()
     np.testing.assert_array_equal(m3[0], m1[0])
     np.testing.assert_array_equal(m3[1], m2[1])
+
+
+# ---------------------------------------------------------------------------
+# round-3: AMP finiteness / adamw / reset_arrays / legacy aliases
+# ---------------------------------------------------------------------------
+
+nd = mx.nd
+
+
+def test_all_finite_family():
+    assert nd.all_finite(nd.array([1.0, 2.0])).asnumpy()[0] == 1.0
+    assert nd.all_finite(nd.array([1.0, np.inf])).asnumpy()[0] == 0.0
+    assert nd.all_finite(nd.array([np.nan])).asnumpy()[0] == 0.0
+    ok = nd.multi_all_finite(nd.ones((2,)), nd.ones((3,)))
+    bad = nd.multi_all_finite(nd.ones((2,)), nd.array([np.nan]))
+    assert ok.asnumpy()[0] == 1.0 and bad.asnumpy()[0] == 0.0
+
+
+def test_reset_arrays():
+    a, b = nd.ones((2, 2)), nd.full((3,), 7.0)
+    out = nd.reset_arrays(a, b, num_arrays=2)
+    # reference contract: pure side effect — inputs are zeroed in place
+    assert out is None
+    assert np.all(a.asnumpy() == 0) and np.all(b.asnumpy() == 0)
+
+
+def test_adamw_update_decoupled_decay():
+    w = nd.ones((4,))
+    g = nd.zeros((4,))
+    m = nd.zeros((4,))
+    v = nd.zeros((4,))
+    # zero grad -> pure decoupled decay: w -= eta * wd * w
+    w2, m2, v2 = nd.adamw_update(w, g, m, v, nd.array(1.0), lr=0.1, wd=0.1,
+                                 eta=1.0)
+    np.testing.assert_allclose(w2.asnumpy(), 0.9 * np.ones(4), rtol=1e-6)
+    # multi-tensor variant agrees with the single-tensor op
+    outs = nd.multi_adamw_update(w, nd.full((4,), 0.5), m, v,
+                                 w, nd.full((4,), 0.5), m, v,
+                                 lrs=(0.01, 0.01), wds=(0.0, 0.0))
+    single = nd.adamw_update(w, nd.full((4,), 0.5), m, v, nd.array(1.0),
+                             lr=0.01, wd=0.0)
+    np.testing.assert_allclose(outs[0].asnumpy(), single[0].asnumpy(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(outs[3].asnumpy(), single[0].asnumpy(),
+                               rtol=1e-6)
+    # mp variant keeps a float32 master copy
+    outs5 = nd.multi_mp_adamw_update(
+        w.astype("float16"), nd.full((4,), 0.5), m, v, w,
+        lrs=(0.01,), wds=(0.0,))
+    assert outs5[0].dtype == np.float16 and outs5[3].dtype == np.float32
+
+
+def test_legacy_v1_aliases():
+    x = nd.random.uniform(shape=(1, 3, 8, 8))
+    w = nd.random.uniform(shape=(4, 3, 3, 3))
+    b = nd.zeros((4,))
+    y1 = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    y2 = nd.Convolution_v1(x, w, b, kernel=(3, 3), num_filter=4)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy())
+    np.testing.assert_allclose(
+        nd.broadcast_plus(nd.ones((2, 1)), nd.ones((1, 3))).asnumpy(),
+        2 * np.ones((2, 3)))
+    # scalar-attr form: shape IS the output shape (reference _random_gamma)
+    g = nd.random_gamma(alpha=9.0, beta=0.5, shape=(2, 2))
+    assert g.shape == (2, 2) and np.all(g.asnumpy() > 0)
+    assert mx.nd.cast_storage(nd.array([[0, 1]]), "csr").stype == "csr"

@@ -555,3 +555,115 @@ def test_correlation_ceil_output_size():
                          max_displacement=1, stride1=2, stride2=1,
                          pad_size=1).asnumpy()
     assert out.shape == (1, 9, 4, 4)
+
+
+# ---------------------------------------------------------------------------
+# round-3 additions: box codec, bipartite matching, sliding-window attention,
+# multi-tensor LAMB, legacy Crop
+# ---------------------------------------------------------------------------
+
+
+def test_box_encode_decode_roundtrip():
+    rng = np.random.RandomState(7)
+    anchors = np.zeros((2, 6, 4), np.float32)
+    anchors[..., :2] = rng.rand(2, 6, 2)
+    anchors[..., 2:] = anchors[..., :2] + 0.2 + rng.rand(2, 6, 2) * 0.5
+    refs = np.zeros((2, 3, 4), np.float32)
+    refs[..., :2] = rng.rand(2, 3, 2)
+    refs[..., 2:] = refs[..., :2] + 0.2 + rng.rand(2, 3, 2) * 0.5
+    samples = np.ones((2, 6), np.float32)
+    matches = rng.randint(0, 3, (2, 6)).astype(np.float32)
+
+    t, m = nd.contrib.box_encode(nd.array(samples), nd.array(matches),
+                                 nd.array(anchors), nd.array(refs))
+    assert m.asnumpy().min() == 1.0
+    dec = nd.contrib.box_decode(t, nd.array(anchors))
+    want = refs[np.arange(2)[:, None], matches.astype(int)]
+    assert_almost_equal(dec.asnumpy(), want, rtol=1e-4, atol=1e-4)
+    # unmatched anchors get zeroed targets and masks
+    t2, m2 = nd.contrib.box_encode(nd.zeros((2, 6)), nd.array(matches),
+                                   nd.array(anchors), nd.array(refs))
+    assert np.all(t2.asnumpy() == 0) and np.all(m2.asnumpy() == 0)
+
+
+def test_bipartite_matching():
+    score = np.array([[[0.5, 0.6, 0.9],
+                       [0.8, 0.3, 0.4]]], np.float32)
+    rows, cols = nd.contrib.bipartite_matching(nd.array(score),
+                                               threshold=0.1)
+    # greedy: (0,2)=0.9 first, then (1,0)=0.8
+    assert rows.asnumpy().tolist() == [[2.0, 0.0]]
+    assert cols.asnumpy().tolist() == [[1.0, -1.0, 0.0]]
+    # threshold prunes weak pairs
+    rows2, _ = nd.contrib.bipartite_matching(nd.array(score), threshold=0.85)
+    assert rows2.asnumpy().tolist() == [[2.0, -1.0]]
+    # ascending = smallest first
+    rows3, _ = nd.contrib.bipartite_matching(nd.array(score), is_ascend=True,
+                                             threshold=10.0)
+    assert rows3.asnumpy()[0, 1] == 1.0
+
+
+def test_sldwin_atten_vs_dense():
+    rng = np.random.RandomState(3)
+    BH, T, D, w = 2, 7, 4, 2
+    q = rng.randn(BH, T, D).astype(np.float32)
+    k = rng.randn(BH, T, D).astype(np.float32)
+    v = rng.randn(BH, T, D).astype(np.float32)
+    s = nd.contrib.sldwin_atten_score(nd.array(q), nd.array(k), w=w).asnumpy()
+    dense = np.einsum("btd,bsd->bts", q, k)
+    for i in range(T):
+        for j, off in enumerate(range(-w, w + 1)):
+            col = i + off
+            want = dense[:, i, col] if 0 <= col < T else 0.0
+            assert_almost_equal(s[:, i, j], want, rtol=1e-5, atol=1e-5)
+    ctx = nd.contrib.sldwin_atten_context(nd.array(s), nd.array(v),
+                                          w=w).asnumpy()
+    mask = np.zeros((T, T), np.float32)
+    for i in range(T):
+        mask[i, max(0, i - w):min(T, i + w + 1)] = 1
+    want_ctx = np.einsum("bts,bsd->btd", dense * mask, v)
+    assert_almost_equal(ctx, want_ctx, rtol=1e-4, atol=1e-4)
+
+
+def test_multi_lamb_update_matches_phases():
+    rng = np.random.RandomState(0)
+    ws = [rng.rand(4).astype(np.float32) for _ in range(2)]
+    gs = [rng.rand(4).astype(np.float32) for _ in range(2)]
+    arrays = []
+    for w, g in zip(ws, gs):
+        arrays += [nd.array(w), nd.array(g), nd.zeros(4), nd.zeros(4)]
+    out = nd.multi_lamb_update(*arrays, step_count=(1, 1),
+                               learning_rates=(0.02, 0.02), wds=(0.01, 0.01))
+    assert len(out) == 6
+    for i, (w, g) in enumerate(zip(ws, gs)):
+        d, m2, v2 = nd.lamb_update_phase1(nd.array(w), nd.array(g),
+                                          nd.zeros(4), nd.zeros(4),
+                                          t=1, wd=0.01)
+        r1 = np.linalg.norm(w)
+        r2 = np.linalg.norm(d.asnumpy())
+        want = nd.lamb_update_phase2(nd.array(w), d, nd.array(r1),
+                                     nd.array(r2), 0.02)
+        assert_almost_equal(out[3 * i].asnumpy(), want.asnumpy(),
+                            rtol=1e-5, atol=1e-6)
+        assert_almost_equal(out[3 * i + 1].asnumpy(), m2.asnumpy(),
+                            rtol=1e-5, atol=1e-6)
+    # mp variant keeps fp32 master weights
+    arrays5 = []
+    for w, g in zip(ws, gs):
+        arrays5 += [nd.array(w).astype("float16"), nd.array(g),
+                    nd.zeros(4), nd.zeros(4), nd.array(w)]
+    out5 = nd.multi_mp_lamb_update(*arrays5, step_count=(1, 1),
+                                   learning_rates=(0.02, 0.02),
+                                   wds=(0.01, 0.01))
+    assert out5[0].dtype == np.float16 and out5[3].dtype == np.float32
+
+
+def test_crop_op():
+    x = nd.array(np.arange(2 * 3 * 8 * 8, dtype=np.float32).reshape(2, 3, 8, 8))
+    y = nd.Crop(x, h_w=(4, 4), center_crop=True)
+    assert y.shape == (2, 3, 4, 4)
+    assert_almost_equal(y.asnumpy(), x.asnumpy()[:, :, 2:6, 2:6])
+    ref = nd.zeros((1, 1, 5, 6))
+    z = nd.Crop(x, ref, offset=(1, 2))
+    assert z.shape == (2, 3, 5, 6)
+    assert_almost_equal(z.asnumpy(), x.asnumpy()[:, :, 1:6, 2:8])
