@@ -48,6 +48,7 @@ from . import symbol as sym  # noqa: F401
 from .symbol import Symbol  # noqa: F401
 from . import module  # noqa: F401
 from . import monitor  # noqa: F401
+from . import library  # noqa: F401
 from . import visualization  # noqa: F401
 from . import parallel  # noqa: F401
 from . import operator  # noqa: F401

@@ -16,8 +16,7 @@ class DataLoaderIter(DataIter):
 
     def __init__(self, loader, data_name="data", label_name="softmax_label",
                  dtype=None):
-        super().__init__(batch_size=getattr(loader, "_batch_sampler", None)
-                         and loader._batch_sampler._batch_size or 0)
+        super().__init__(batch_size=0)  # real value set from the first batch
         self._loader = loader
         self._iter = None
         self._data_name = data_name

@@ -27,6 +27,7 @@ from . import op  # noqa: F401
 from .op import *  # noqa: F401,F403
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import image  # noqa: F401
 from .sparse import cast_storage  # noqa: F401  (reference: top-level nd.cast_storage)
 from . import contrib  # noqa: F401
 

@@ -1,0 +1,31 @@
+"""``mx.nd.image`` namespace (reference: ``python/mxnet/ndarray/image.py``,
+generated from the ``_image_*`` op family — see ``ops/image_ops.py``)."""
+
+from __future__ import annotations
+
+from . import op as _op
+
+# friendly-name -> registry-name (canonical names avoid clobbering
+# same-named tensor ops like `crop`/`normalize` in the flat nd namespace)
+_NAME_MAP = {
+    "to_tensor": "to_tensor",
+    "normalize": "image_normalize",
+    "resize": "image_resize",
+    "crop": "image_crop",
+    "flip_left_right": "flip_left_right",
+    "flip_top_bottom": "flip_top_bottom",
+    "random_flip_left_right": "random_flip_left_right",
+    "random_flip_top_bottom": "random_flip_top_bottom",
+    "random_brightness": "random_brightness",
+    "random_contrast": "random_contrast",
+    "random_saturation": "random_saturation",
+    "random_hue": "random_hue",
+    "random_color_jitter": "random_color_jitter",
+    "adjust_lighting": "adjust_lighting",
+    "random_lighting": "random_lighting",
+}
+
+for _friendly, _reg in _NAME_MAP.items():
+    globals()[_friendly] = getattr(_op, _reg)
+
+__all__ = list(_NAME_MAP)

@@ -155,3 +155,20 @@ def _jnp_zeros_like(x):
     import jax.numpy as jnp
 
     return jnp.zeros_like(x)
+
+
+def onehot_encode(indices, out):
+    """Legacy in-place one-hot (reference: ``ndarray_function.cc``
+    ``_onehot_encode``): writes into ``out`` AND returns it — callers
+    rely on the mutation."""
+    opdef = _registry.get("_onehot_encode")
+    res = _apply(opdef, [indices, out], {})
+    from .ndarray import NDArray
+
+    if isinstance(out, NDArray):
+        out._set_data(res.data if isinstance(res, NDArray) else res)
+        return out
+    return res
+
+
+_onehot_encode = onehot_encode

@@ -1,0 +1,237 @@
+"""The ``mx.nd.image`` operator family (reference:
+``src/operator/image/image_random.cc``, ``resize.cc``, ``crop.cc`` —
+``_image_to_tensor``, ``_image_normalize``, ``_image_resize``,
+``_image_crop``, ``_image_flip_*``, ``_image_random_*``,
+``_image_adjust_lighting``).
+
+Layout convention matches the reference: images are HWC (or NHWC
+batched), uint8 [0,255] or float. TPU-first notes: resize is
+``jax.image.resize`` (XLA gather/dot lowering); color jitter is pure
+elementwise math that fuses; the ``random_*`` variants draw factors from
+the framework key stream (``mx.random``) at dispatch time (eager, like
+every sampling op here) so augmentation remains reproducible under
+``mx.random.seed``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import random as _random
+from .registry import register
+
+
+def _hwc_axes(x):
+    """(h_axis, w_axis, c_axis) for HWC or NHWC input."""
+    if x.ndim == 3:
+        return 0, 1, 2
+    if x.ndim == 4:
+        return 1, 2, 3
+    raise ValueError(f"image op expects HWC or NHWC, got shape {x.shape}")
+
+
+@register("to_tensor", aliases=("_image_to_tensor",), jit=True)
+def to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (batched: NHWC -> NCHW)."""
+    x = data.astype(jnp.float32) / 255.0
+    if data.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register("image_normalize", aliases=("_image_normalize",), jit=True)
+def image_normalize(data, mean=(0.0,), std=(1.0,)):
+    """Per-channel (x - mean)/std on CHW (or NCHW) float input."""
+    mean = jnp.asarray(mean, jnp.float32)
+    std = jnp.asarray(std, jnp.float32)
+    shape = (-1, 1, 1) if data.ndim == 3 else (1, -1, 1, 1)
+    return (data - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("image_resize", aliases=("_image_resize",), jit=True)
+def image_resize(data, size=None, keep_ratio=False, interp=1):
+    """Bilinear (interp=1) or nearest (interp=0) HWC resize; ``size`` is
+    (w, h) or a single int, reference semantics."""
+    h_ax, w_ax, _ = _hwc_axes(data)
+    h, w = data.shape[h_ax], data.shape[w_ax]
+    if isinstance(size, int):
+        if keep_ratio:
+            if h > w:
+                new_w, new_h = size, int(h * size / w)
+            else:
+                new_w, new_h = int(w * size / h), size
+        else:
+            new_w = new_h = size
+    else:
+        new_w, new_h = size
+    method = "nearest" if interp == 0 else "linear"
+    shape = list(data.shape)
+    shape[h_ax], shape[w_ax] = new_h, new_w
+    out = jax.image.resize(data.astype(jnp.float32), tuple(shape), method)
+    return out.astype(data.dtype) if jnp.issubdtype(data.dtype, jnp.integer) \
+        else out
+
+
+@register("image_crop", aliases=("_image_crop",), jit=True)
+def image_crop(data, x=0, y=0, width=0, height=0):
+    """Crop the (x, y, width, height) window out of an HWC/NHWC image."""
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@register("flip_left_right", aliases=("_image_flip_left_right",), jit=True)
+def flip_left_right(data):
+    _, w_ax, _ = _hwc_axes(data)
+    return jnp.flip(data, axis=w_ax)
+
+
+@register("flip_top_bottom", aliases=("_image_flip_top_bottom",), jit=True)
+def flip_top_bottom(data):
+    h_ax, _, _ = _hwc_axes(data)
+    return jnp.flip(data, axis=h_ax)
+
+
+def _coin(p):
+    return float(jax.random.uniform(_random._next_key(), ())) < p
+
+
+@register("random_flip_left_right",
+          aliases=("_image_random_flip_left_right",), jit=False)
+def random_flip_left_right(data, p=0.5):
+    return flip_left_right(data) if _coin(p) else jnp.asarray(data)
+
+
+@register("random_flip_top_bottom",
+          aliases=("_image_random_flip_top_bottom",), jit=False)
+def random_flip_top_bottom(data, p=0.5):
+    return flip_top_bottom(data) if _coin(p) else jnp.asarray(data)
+
+
+def _uniform_factor(lo, hi):
+    return float(jax.random.uniform(_random._next_key(), (),
+                                    minval=lo, maxval=hi))
+
+
+def _blend(a, b, f):
+    return a.astype(jnp.float32) * f + b * (1.0 - f)
+
+
+def _gray(x, c_ax):
+    w = jnp.asarray([0.299, 0.587, 0.114], jnp.float32)
+    shape = [1] * x.ndim
+    shape[c_ax] = 3
+    return jnp.sum(x.astype(jnp.float32) * w.reshape(shape), axis=c_ax,
+                   keepdims=True)
+
+
+@register("random_brightness", aliases=("_image_random_brightness",),
+          jit=False)
+def random_brightness(data, min_factor=1.0, max_factor=1.0):
+    """Scale by f ~ U[min_factor, max_factor] (reference contract:
+    the factor range IS the argument pair; f=1 is identity — gluon's
+    ``RandomBrightness(b)`` passes ``(max(0, 1-b), 1+b)``)."""
+    f = _uniform_factor(min_factor, max_factor)
+    return jnp.asarray(data).astype(jnp.float32) * f
+
+
+def _img_mean(x, c_ax):
+    """Per-IMAGE gray mean: reduce H, W, C but keep the batch axis."""
+    g = _gray(x, c_ax)
+    axes = tuple(range(x.ndim - 3, x.ndim)) if x.ndim == 4 else None
+    if x.ndim == 4:
+        return g.mean(axis=(1, 2, 3), keepdims=True)
+    return g.mean()
+
+
+@register("random_contrast", aliases=("_image_random_contrast",), jit=False)
+def random_contrast(data, min_factor=1.0, max_factor=1.0):
+    """Blend toward each image's own gray mean with f ~ U[min, max]."""
+    x = jnp.asarray(data)
+    _, _, c_ax = _hwc_axes(x)
+    f = _uniform_factor(min_factor, max_factor)
+    return _blend(x, _img_mean(x, c_ax), f)
+
+
+@register("random_saturation", aliases=("_image_random_saturation",),
+          jit=False)
+def random_saturation(data, min_factor=1.0, max_factor=1.0):
+    x = jnp.asarray(data)
+    _, _, c_ax = _hwc_axes(x)
+    f = _uniform_factor(min_factor, max_factor)
+    return _blend(x, _gray(x, c_ax), f)
+
+
+@register("random_hue", aliases=("_image_random_hue",), jit=False)
+def random_hue(data, min_factor=1.0, max_factor=1.0):
+    """Hue rotation via the YIQ chroma-plane rotation (the linear-RGB
+    approximation the reference kernel uses). f ~ U[min, max]; f=1 is
+    identity and the rotation angle is (f-1)*pi, so gluon's
+    ``RandomHue(h)`` range (1-h, 1+h) sweeps (-h*pi, +h*pi)."""
+    import numpy as onp
+
+    x = jnp.asarray(data).astype(jnp.float32)
+    _, _, c_ax = _hwc_axes(x)
+    alpha = (_uniform_factor(min_factor, max_factor) - 1.0) \
+        * 3.141592653589793
+    u, w = onp.cos(alpha), onp.sin(alpha)
+    t_yiq = onp.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], onp.float32)
+    t_rgb = onp.linalg.inv(t_yiq)
+    rot = onp.array([[1, 0, 0], [0, u, -w], [0, w, u]], onp.float32)
+    m = jnp.asarray(t_rgb @ rot @ t_yiq)
+    x = jnp.moveaxis(x, c_ax, -1)
+    y = x @ m.T
+    return jnp.moveaxis(y, -1, c_ax)
+
+
+@register("random_color_jitter", aliases=("_image_random_color_jitter",),
+          jit=False)
+def random_color_jitter(data, brightness=0.0, contrast=0.0, saturation=0.0,
+                        hue=0.0):
+    """Compose brightness/contrast/saturation/hue jitter in a random
+    order (reference applies them in randomized sequence)."""
+    steps = []
+    if brightness:
+        steps.append(lambda im: random_brightness(
+            im, max(0.0, 1 - brightness), 1 + brightness))
+    if contrast:
+        steps.append(lambda im: random_contrast(
+            im, max(0.0, 1 - contrast), 1 + contrast))
+    if saturation:
+        steps.append(lambda im: random_saturation(
+            im, max(0.0, 1 - saturation), 1 + saturation))
+    if hue:
+        steps.append(lambda im: random_hue(im, max(0.0, 1 - hue), 1 + hue))
+    order = jax.random.permutation(_random._next_key(), len(steps)) \
+        if steps else []
+    x = jnp.asarray(data)
+    for i in [int(i) for i in order]:
+        x = steps[i](x)
+    return x
+
+
+@register("adjust_lighting", aliases=("_image_adjust_lighting",), jit=False)
+def adjust_lighting(data, alpha=(0.0, 0.0, 0.0)):
+    """AlexNet-style PCA lighting with the reference's fixed ImageNet
+    eigenvectors/eigenvalues."""
+    import numpy as onp
+
+    eigval = onp.array([55.46, 4.794, 1.148], onp.float32)
+    eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], onp.float32)
+    delta = jnp.asarray(eigvec @ (onp.asarray(alpha, onp.float32) * eigval))
+    x = jnp.asarray(data).astype(jnp.float32)
+    _, _, c_ax = _hwc_axes(x)
+    shape = [1] * x.ndim
+    shape[c_ax] = 3
+    return x + delta.reshape(shape)
+
+
+@register("random_lighting", aliases=("_image_random_lighting",), jit=False)
+def random_lighting(data, alpha_std=0.05):
+    a = jax.random.normal(_random._next_key(), (3,)) * alpha_std
+    return adjust_lighting(data, tuple(float(v) for v in a))

@@ -384,3 +384,21 @@ _alias_existing(("Convolution_v1",), "Convolution")
 _alias_existing(("Pooling_v1",), "Pooling")
 _alias_existing(("broadcast_plus",), "broadcast_add")
 _alias_existing(("broadcast_minus",), "broadcast_sub")
+
+
+@register("logspace", jit=False)
+def logspace(start=0.0, stop=1.0, num=50, endpoint=True, base=10.0,
+             dtype="float32", ctx=None, **kw):
+    """(reference: ``init_op.cc`` family; numpy semantics)."""
+    return jnp.logspace(float(start), float(stop), int(num),
+                        endpoint=endpoint, base=float(base),
+                        dtype=jnp.dtype(dtype))
+
+
+@register("_onehot_encode", aliases=("onehot_encode",))
+def onehot_encode(indices, out_like):
+    """Legacy one-hot into a preallocated-shape output (reference:
+    ``ndarray_function.cc`` ``_onehot_encode``: out[i, indices[i]] = 1)."""
+    n, k = out_like.shape
+    return (indices.astype(jnp.int32)[:, None]
+            == jnp.arange(k, dtype=jnp.int32)[None, :]).astype(out_like.dtype)

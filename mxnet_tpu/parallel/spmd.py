@@ -206,6 +206,16 @@ class SPMDTrainStep:
         if (dp and raw.ndim >= 1 and raw.shape[0] % dp == 0
                 and not (len(pspec) > 0 and pspec[0] is not None)):
             return P(self.batch_axis, *([None] * (raw.ndim - 1)))
+        if (dp and raw.ndim >= 1 and raw.shape[0] % dp != 0
+                and not (len(pspec) > 0 and pspec[0] is not None)):
+            # visible fallback: on a real pod a silently replicated moment
+            # is an invisible memory-budget surprise
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ZeRO-1: opt state for %r (shape %s) not divisible by "
+                "dp=%d; falling back to the param sharding %s",
+                name, tuple(raw.shape), dp, pspec)
         return pspec
 
     def init_state(self):

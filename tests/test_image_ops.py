@@ -1,0 +1,92 @@
+"""mx.nd.image op family (reference: src/operator/image/image_random.cc,
+resize.cc, crop.cc; python/mxnet/ndarray/image.py)."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _img(h=8, w=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def test_to_tensor_and_normalize():
+    img = _img()
+    t = mx.nd.image.to_tensor(mx.nd.array(img))
+    assert t.shape == (3, 8, 6)
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+    n = mx.nd.image.normalize(t, mean=(0.1, 0.2, 0.3), std=(0.5, 0.5, 0.5))
+    want = (img.transpose(2, 0, 1) / 255.0
+            - np.array([0.1, 0.2, 0.3])[:, None, None]) / 0.5
+    np.testing.assert_allclose(n.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    # batched NHWC -> NCHW
+    tb = mx.nd.image.to_tensor(mx.nd.array(np.stack([img, img])))
+    assert tb.shape == (2, 3, 8, 6)
+
+
+def test_resize_crop_flip():
+    img = _img()
+    r = mx.nd.image.resize(mx.nd.array(img), size=(3, 4))  # (w, h)
+    assert r.shape == (4, 3, 3)
+    # nearest at identity size == input
+    same = mx.nd.image.resize(mx.nd.array(img), size=(6, 8), interp=0)
+    np.testing.assert_array_equal(same.asnumpy(), img)
+    # keep_ratio with int size scales the short side
+    kr = mx.nd.image.resize(mx.nd.array(img), size=4, keep_ratio=True)
+    assert kr.shape[1] == 4 and kr.shape[0] == round(8 * 4 / 6)
+    c = mx.nd.image.crop(mx.nd.array(img), x=1, y=2, width=4, height=5)
+    np.testing.assert_array_equal(c.asnumpy(), img[2:7, 1:5])
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_left_right(mx.nd.array(img)).asnumpy(),
+        img[:, ::-1])
+    np.testing.assert_array_equal(
+        mx.nd.image.flip_top_bottom(mx.nd.array(img)).asnumpy(),
+        img[::-1])
+
+
+def test_color_jitter_family():
+    mx.random.seed(7)
+    img = mx.nd.array(_img().astype(np.float32))
+    # reference contract: f ~ U[min_factor, max_factor]; f=1 is identity
+    np.testing.assert_allclose(
+        mx.nd.image.random_brightness(img, 1.0, 1.0).asnumpy(),
+        img.asnumpy())
+    np.testing.assert_allclose(
+        mx.nd.image.random_hue(img, 1.0, 1.0).asnumpy(),
+        img.asnumpy(), rtol=1e-4, atol=1e-3)
+    # a pinned factor scales all channels identically
+    b = mx.nd.image.random_brightness(img, 1.5, 1.5).asnumpy()
+    src = img.asnumpy()
+    nz = src > 1.0
+    f = (b[nz] / src[nz]).flat[0]
+    np.testing.assert_allclose(b, src * f, rtol=1e-4)
+    np.testing.assert_allclose(f, 1.5, rtol=1e-5)
+    # pinned contrast factor 1.0 is identity even batched (per-image mean)
+    batch = mx.nd.array(np.stack([src, src * 0.1]))
+    cb = mx.nd.image.random_contrast(batch, 1.0, 1.0).asnumpy()
+    np.testing.assert_allclose(cb, batch.asnumpy(), rtol=1e-5)
+    # factor-0 contrast collapses each image to ITS OWN gray mean
+    c0 = mx.nd.image.random_contrast(batch, 0.0, 0.0).asnumpy()
+    g = batch.asnumpy() @ np.array([0.299, 0.587, 0.114], np.float32)
+    m_per = g.reshape(2, -1).mean(axis=1)
+    np.testing.assert_allclose(c0[0], np.full_like(c0[0], m_per[0]), rtol=1e-4)
+    np.testing.assert_allclose(c0[1], np.full_like(c0[1], m_per[1]), rtol=1e-4)
+    # saturation toward gray: factor-0 blend equals the gray image
+    j = mx.nd.image.random_color_jitter(img, brightness=0.2, contrast=0.2,
+                                        saturation=0.2, hue=0.1)
+    assert j.shape == img.shape
+    # lighting is a per-channel additive shift
+    l = mx.nd.image.adjust_lighting(img, alpha=(0.01, 0.0, 0.0)).asnumpy()
+    delta = l - img.asnumpy()
+    assert np.allclose(delta, delta[0, 0], atol=1e-4)
+
+
+def test_image_random_ops_reproducible():
+    img = mx.nd.array(_img().astype(np.float32))
+    mx.random.seed(42)
+    a = mx.nd.image.random_color_jitter(img, brightness=0.4).asnumpy()
+    mx.random.seed(42)
+    b = mx.nd.image.random_color_jitter(img, brightness=0.4).asnumpy()
+    np.testing.assert_allclose(a, b)
