@@ -70,7 +70,7 @@ def bench_resnet(backend):
     from mxnet_tpu import engine, gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
-    batch = int(os.environ.get("BENCH_BATCH", "64" if backend != "cpu" else "8"))
+    batch = int(os.environ.get("BENCH_BATCH", "128" if backend != "cpu" else "8"))  # measured: 128 > 64 (2312 vs 2184 img/s) > 256
     size = int(os.environ.get("BENCH_IMG", "224" if backend != "cpu" else "32"))
     dtype = os.environ.get("BENCH_DTYPE",
                            "bfloat16" if backend != "cpu" else "float32")

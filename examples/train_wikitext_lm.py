@@ -1,0 +1,76 @@
+"""Word-level language model on a WikiText-style corpus.
+
+Shows the contrib data/text path end-to-end (reference:
+``example/gluon/word_language_model``): ``CorpusDataset`` (next-token
+layout) -> ``DataLoader`` -> Embedding + LSTM -> softmax CE, hybridized.
+
+Run:  python examples/train_wikitext_lm.py [path/to/tokens.txt]
+(without an argument a tiny synthetic corpus is generated).
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.contrib.data import CorpusDataset
+
+SEQ, BATCH, EMBED, HIDDEN, EPOCHS = 16, 8, 32, 64, 3
+
+
+class RNNModel(gluon.HybridBlock):
+    def __init__(self, vocab_size, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.embed = gluon.nn.Embedding(vocab_size, EMBED)
+            self.rnn = gluon.rnn.LSTM(HIDDEN, layout="NTC")
+            self.decoder = gluon.nn.Dense(vocab_size, flatten=False)
+
+    def hybrid_forward(self, F, x):
+        return self.decoder(self.rnn(self.embed(x)))
+
+
+def main():
+    if len(sys.argv) > 1:
+        corpus = sys.argv[1]
+    else:
+        rng = np.random.RandomState(0)
+        words = ["tpu", "mesh", "shard", "fuse", "compile", "train",
+                 "step", "loss", "grad", "psum"]
+        text = "\n".join(" ".join(rng.choice(words, 12)) for _ in range(200))
+        corpus = os.path.join(tempfile.mkdtemp(), "corpus.txt")
+        with open(corpus, "w") as f:
+            f.write(text)
+
+    ds = CorpusDataset(corpus, seq_len=SEQ)
+    vocab = ds.vocabulary
+    loader = gluon.data.DataLoader(ds, batch_size=BATCH,
+                                   last_batch="discard", shuffle=True)
+    net = RNNModel(len(vocab))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(EPOCHS):
+        total, n = 0.0, 0
+        for x, y in loader:
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.asnumpy())
+            n += 1
+        ppl = float(np.exp(total / max(n, 1)))
+        print(f"epoch {epoch}: loss {total / max(n, 1):.3f}  ppl {ppl:.1f}  "
+              f"(vocab {len(vocab)})")
+
+
+if __name__ == "__main__":
+    main()

@@ -133,10 +133,10 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
     if quantized_dtype != "int8":
         raise MXNetError("only int8 quantization is implemented "
                          "(reference default); use amp for bf16")
-    if calib_mode != "naive":
-        raise MXNetError("calib_mode='naive' (min/max) is the implemented "
-                         "calibration; entropy calibration is a "
-                         "documented drop")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError("calib_mode must be 'naive' (min/max) or "
+                         "'entropy' (KL-minimizing threshold, reference "
+                         "calibrate.cc)")
     layers = _walk(net)
 
     # --- plan stages, folding BatchNorm into the preceding conv/dense ----
@@ -194,8 +194,9 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
 
     # --- calibration: record input ranges of quantizable stages ----------
     ranges = {}  # stage index -> [min, max]
+    samples = {}  # stage index -> list of |x| samples (entropy mode)
     if calib_data is None:
-        raise MXNetError("calib_data is required for calib_mode='naive'")
+        raise MXNetError("calib_data is required for calibration")
     from ..ndarray import op as ndop
 
     for batch in calib_data:
@@ -210,6 +211,11 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                         ranges[si][1] = max(ranges[si][1], hi)
                     else:
                         ranges[si] = [lo, hi]
+                    if calib_mode == "entropy":
+                        flat = np.abs(np.asarray(raw, np.float32)).ravel()
+                        if flat.size > 16384:  # bound calibration memory
+                            flat = flat[:: flat.size // 16384 + 1]
+                        samples.setdefault(si, []).append(flat)
                 kind = kind.replace("float_", "")
                 # run the FOLDED float math (the BN is gone from the plan,
                 # so downstream ranges must see the folded activations)
@@ -226,6 +232,25 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                 raw = layer(NDArray(raw)).data
             elif kind == "flatten":
                 raw = raw.reshape(raw.shape[0], -1)
+
+    if calib_mode == "entropy":
+        # KL-minimizing symmetric thresholds (reference calibrate.cc via
+        # the _contrib_calibrate_entropy op)
+        from ..ops.registry import get as _get_op
+
+        _calib = _get_op("calibrate_entropy").fn
+        for si, chunks in samples.items():
+            vals = np.concatenate(chunks)
+            amax = float(vals.max()) or 1.0
+            # reference calibrate.cc uses 8001 bins over millions of
+            # activations; with few samples that histogram is so sparse
+            # the KL estimate is noise — scale bins to the sample count
+            bins = 8001 if vals.size >= 100_000 else \
+                2001 if vals.size >= 10_000 else 401
+            hist, edges = np.histogram(
+                np.concatenate([-vals, vals]), bins=bins, range=(-amax, amax))
+            thr = float(_calib(jnp.asarray(hist), jnp.asarray(edges))[0][0])
+            ranges[si] = [-thr, thr]
 
     # --- build the quantized pipeline ------------------------------------
     stages = []

@@ -1,0 +1,4 @@
+"""``mx.gluon.contrib.data`` (reference: ``python/mxnet/gluon/contrib/data/``)."""
+
+from . import text  # noqa: F401
+from .text import CorpusDataset, WikiText2, WikiText103  # noqa: F401

@@ -1,0 +1,99 @@
+"""Language-modelling text datasets (reference:
+``python/mxnet/gluon/contrib/data/text.py`` — ``WikiText2``,
+``WikiText103`` over a ``_LanguageModelDataset`` base).
+
+The reference downloads the corpora from S3 at construction. This build
+runs with zero network egress, so the datasets read ALREADY-PRESENT
+token files from ``root`` and raise a clear error otherwise; the base
+``CorpusDataset`` takes any local file, which is also what the tests
+feed. Tokenisation, vocabulary construction (frequency-sorted via
+``mx.contrib.text.Vocabulary``), eos-appending, and the
+(seq_len, data/label-shifted-by-one) sample layout follow the reference.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....contrib import text as _text
+from ...data.dataset import Dataset
+
+
+class CorpusDataset(Dataset):
+    """Fixed-length language-model samples from a token file.
+
+    Each sample is ``(data, label)`` where ``label`` is ``data`` shifted
+    one token left — the next-token-prediction layout
+    (reference: ``_LanguageModelDataset._build``)."""
+
+    def __init__(self, filename, seq_len=35, bos=None, eos="<eos>",
+                 tokenizer=None, vocab=None):
+        self._filename = filename
+        self._seq_len = seq_len
+        self._bos = bos
+        self._eos = eos
+        self._tokenizer = tokenizer or (lambda line: line.split())
+        if not os.path.exists(filename):
+            raise MXNetError(f"corpus file not found: {filename}")
+        tokens = []
+        with open(filename, encoding="utf-8") as f:
+            for line in f:
+                parts = self._tokenizer(line.strip())
+                if not parts:
+                    continue
+                if bos:
+                    tokens.append(bos)
+                tokens.extend(parts)
+                if eos:
+                    tokens.append(eos)
+        if vocab is None:
+            import collections
+
+            vocab = _text.Vocabulary(collections.Counter(tokens))
+        self.vocabulary = vocab
+        ids = onp.asarray(vocab.to_indices(tokens), dtype=onp.int32)
+        n = (len(ids) - 1) // seq_len
+        self._data = ids[:n * seq_len].reshape(n, seq_len)
+        self._label = ids[1:n * seq_len + 1].reshape(n, seq_len)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        from ....ndarray import ndarray as nd
+
+        return nd.array(self._data[idx]), nd.array(self._label[idx])
+
+
+class _WikiText(CorpusDataset):
+    _namespace = None
+    _files = {"train": "wiki.train.tokens", "validation": "wiki.valid.tokens",
+              "test": "wiki.test.tokens"}
+
+    def __init__(self, root, segment="train", seq_len=35, vocab=None):
+        if segment not in self._files:
+            raise MXNetError(
+                f"segment must be one of {sorted(self._files)}; got {segment}")
+        path = os.path.join(os.path.expanduser(root), self._files[segment])
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"{type(self).__name__}: token file {path!r} not found. This "
+                "build runs without network access — place the extracted "
+                f"{self._namespace} token files under {root!r} (the reference "
+                "downloaded them automatically).")
+        super().__init__(path, seq_len=seq_len, eos="<eos>", vocab=vocab)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference: ``contrib/data/text.py`` ``WikiText2``)."""
+
+    _namespace = "wikitext-2"
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference: ``contrib/data/text.py`` ``WikiText103``)."""
+
+    _namespace = "wikitext-103"

@@ -7,8 +7,6 @@ hybridize like any other op.
 
 from __future__ import annotations
 
-import random as _pyrandom
-
 import numpy as _np
 
 from ....ndarray.ndarray import NDArray, array as _array
@@ -131,15 +129,22 @@ class RandomCrop(Block):
         return random_crop(x, self._size, self._interpolation)[0]
 
 
+# the random photometric transforms delegate to the `_image_*` op family
+# (ops/image_ops.py) — ONE implementation of the jitter math, and the
+# factors are drawn from the framework key stream so pipelines are
+# reproducible under mx.random.seed (the earlier Block-local copies used
+# Python `random` and ignored it).
+
+
 class RandomFlipLeftRight(Block):
     def __init__(self, p=0.5):
         super().__init__()
         self._p = p
 
     def forward(self, x):
-        if _pyrandom.random() < self._p:
-            return x.flip(axis=1 if x.ndim == 3 else 2)
-        return x
+        from ....ndarray import image as _img
+
+        return _img.random_flip_left_right(x, p=self._p)
 
 
 class RandomFlipTopBottom(Block):
@@ -148,100 +153,75 @@ class RandomFlipTopBottom(Block):
         self._p = p
 
     def forward(self, x):
-        if _pyrandom.random() < self._p:
-            return x.flip(axis=0 if x.ndim == 3 else 1)
-        return x
+        from ....ndarray import image as _img
+
+        return _img.random_flip_top_bottom(x, p=self._p)
 
 
 class RandomBrightness(Block):
     def __init__(self, brightness):
         super().__init__()
-        self._delta = brightness
+        self._args = (max(0.0, 1 - brightness), 1 + brightness)
 
     def forward(self, x):
-        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
-        return x.astype("float32") * alpha
+        from ....ndarray import image as _img
+
+        return _img.random_brightness(x, *self._args)
 
 
 class RandomContrast(Block):
     def __init__(self, contrast):
         super().__init__()
-        self._delta = contrast
+        self._args = (max(0.0, 1 - contrast), 1 + contrast)
 
     def forward(self, x):
-        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
-        xf = x.astype("float32")
-        gray = xf.mean()
-        return xf * alpha + gray * (1 - alpha)
+        from ....ndarray import image as _img
+
+        return _img.random_contrast(x, *self._args)
 
 
 class RandomSaturation(Block):
     def __init__(self, saturation):
         super().__init__()
-        self._delta = saturation
+        self._args = (max(0.0, 1 - saturation), 1 + saturation)
 
     def forward(self, x):
-        alpha = 1.0 + _pyrandom.uniform(-self._delta, self._delta)
-        xf = x.astype("float32")
-        coef = _array(_np.array([[[0.299, 0.587, 0.114]]], dtype="float32"))
-        gray = (xf * coef).sum(axis=2, keepdims=True)
-        return xf * alpha + gray * (1 - alpha)
+        from ....ndarray import image as _img
+
+        return _img.random_saturation(x, *self._args)
 
 
 class RandomHue(Block):
     def __init__(self, hue):
         super().__init__()
-        self._delta = hue
+        self._args = (max(0.0, 1 - hue), 1 + hue)
 
     def forward(self, x):
-        # approximate hue rotation in YIQ space (reference uses the same trick)
-        alpha = _pyrandom.uniform(-self._delta, self._delta)
-        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
-        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]])
-        t_yiq = _np.array([[0.299, 0.587, 0.114],
-                           [0.596, -0.274, -0.321],
-                           [0.211, -0.523, 0.311]])
-        t_rgb = _np.linalg.inv(t_yiq)
-        m = t_rgb.dot(bt).dot(t_yiq).T.astype("float32")
-        xf = x.astype("float32")
-        return NDArray(xf.data @ _np.asarray(m), ctx=x.ctx)
+        from ....ndarray import image as _img
+
+        return _img.random_hue(x, *self._args)
 
 
 class RandomColorJitter(Block):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
         super().__init__()
-        self._transforms = []
-        if brightness:
-            self._transforms.append(RandomBrightness(brightness))
-        if contrast:
-            self._transforms.append(RandomContrast(contrast))
-        if saturation:
-            self._transforms.append(RandomSaturation(saturation))
-        if hue:
-            self._transforms.append(RandomHue(hue))
+        self._kwargs = dict(brightness=brightness, contrast=contrast,
+                            saturation=saturation, hue=hue)
 
     def forward(self, x):
-        ts = list(self._transforms)
-        _pyrandom.shuffle(ts)
-        for t in ts:
-            x = t(x)
-        return x
+        from ....ndarray import image as _img
+
+        return _img.random_color_jitter(x, **self._kwargs)
 
 
 class RandomLighting(Block):
     """AlexNet-style PCA noise (reference: ``RandomLighting``)."""
-
-    _eigval = _np.array([55.46, 4.794, 1.148], dtype="float32")
-    _eigvec = _np.array(
-        [[-0.5675, 0.7192, 0.4009],
-         [-0.5808, -0.0045, -0.814],
-         [-0.5836, -0.6948, 0.4203]], dtype="float32")
 
     def __init__(self, alpha):
         super().__init__()
         self._alpha = alpha
 
     def forward(self, x):
-        a = _np.random.normal(0, self._alpha, size=(3,)).astype("float32")
-        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
-        return x.astype("float32") + _array(rgb.reshape((1, 1, 3)))
+        from ....ndarray import image as _img
+
+        return _img.random_lighting(x, alpha_std=self._alpha)

@@ -93,7 +93,15 @@ def _make_registered_fn(native):
     import jax
 
     def fn(*arrays, **ignored_attrs):
+        import jax.numpy as jnp
+
         out_shape = native.infer_shape([a.shape for a in arrays])
+        if not any(isinstance(a, jax.core.Tracer) for a in arrays):
+            # eager: call straight into the C library (also the only path
+            # on relay backends like axon, whose PJRT lacks host
+            # send/recv callbacks)
+            host = [onp.asarray(a, dtype=onp.float32) for a in arrays]
+            return jnp.asarray(native.compute(*host, out_shape=out_shape))
         result = jax.ShapeDtypeStruct(out_shape, onp.float32)
         return jax.pure_callback(
             lambda *xs: native.compute(*xs, out_shape=out_shape), result,
@@ -121,20 +129,23 @@ def load(path, verbose=True):
             raise MXNetError(f"{path}: missing required symbol {sym!r}")
     lib.mxtpu_lib_op_name.restype = ctypes.c_char_p
 
-    from .ndarray import op as nd_op
-    from .ops.registry import register
-
     import logging
 
-    from .ops.registry import all_ops
+    from . import ndarray as nd_pkg
+    from . import symbol as sym_pkg
+    from .ndarray import op as nd_op
+    from .ops.registry import all_ops, get as get_opdef, register
+    from .symbol import op as sym_op
 
+    prior_owner = {n: p for p, ns in _LOADED.items() for n in ns}
     names = []
     for i in range(lib.mxtpu_lib_num_ops()):
         name = lib.mxtpu_lib_op_name(i).decode()
         nin = lib.mxtpu_lib_op_num_inputs(i)
-        if name in all_ops():
+        if name in all_ops() and prior_owner.get(name) != path:
             # the reference MXLoadLib logs when re-registering; overriding
             # a BUILT-IN with host compute is almost always a user error
+            # (re-loading the SAME library is routine and stays silent)
             logging.getLogger(__name__).warning(
                 "mx.library.load: op %r from %s overrides an existing "
                 "registration (now host pure_callback compute)", name,
@@ -143,15 +154,16 @@ def load(path, verbose=True):
         # jit=False: pure_callback handles jit composition itself; the
         # registry-level jit cache would only add a trace layer
         register(name, jit=False)(_make_registered_fn(native))
-        opdef = __import__("mxnet_tpu.ops.registry", fromlist=["get"]).get(name)
+        opdef = get_opdef(name)
         wrapped = nd_op._make_op(opdef)
-        setattr(nd_op, name, wrapped)
-        # `mx.nd` re-exported op.* at import time; publish post-load names
-        # on the package too (reference: stubs are regenerated after
+        # the nd/sym namespaces re-exported op.* at import time; publish
+        # post-load names on both (reference: stubs are regenerated after
         # MXLoadLib by re-running _init_op_module)
-        from . import ndarray as nd_pkg
-
+        setattr(nd_op, name, wrapped)
         setattr(nd_pkg, name, wrapped)
+        sym_fn = sym_op._make_sym_op(opdef)
+        setattr(sym_op, name, sym_fn)
+        setattr(sym_pkg, name, sym_fn)
         names.append(name)
     _LOADED[path] = names
     if verbose:

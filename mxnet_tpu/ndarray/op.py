@@ -151,6 +151,29 @@ def reset_arrays(*arrays, num_arrays=None, **kw):
     return None
 
 
+def BatchNormWithReLU(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+                      momentum=0.9, fix_gamma=True, use_global_stats=False,
+                      output_mean_var=False, axis=1, cudnn_off=False,
+                      out=None, **kw):
+    """Fused BN+ReLU with the same training gate / moving-stat writeback
+    as the BatchNorm wrapper above (reference: BatchNormWithReLU)."""
+    training = autograd.is_training() and not use_global_stats
+    res = _apply(
+        _registry.get("BatchNormWithReLU"),
+        (data, gamma, beta, moving_mean, moving_var),
+        dict(eps=eps, momentum=momentum, fix_gamma=fix_gamma,
+             use_global_stats=use_global_stats,
+             output_mean_var=output_mean_var, axis=axis, training=training),
+        out=out,
+    )
+    if training:
+        out_, new_mean, new_var = res[0], res[1], res[2]
+        moving_mean._set_data(new_mean.data)
+        moving_var._set_data(new_var.data)
+        return out_
+    return res
+
+
 def _jnp_zeros_like(x):
     import jax.numpy as jnp
 

@@ -1,0 +1,401 @@
+"""Second tranche of contrib operators (reference: ``src/operator/contrib/``
+``count_sketch.cu``, ``hawkes_ll.cc``, ``mrcnn_mask_target.cu``,
+``psroi_pooling.cc``, ``deformable_psroi_pooling.cc``, ``rroi_align.cc``,
+``multi_proposal.cc``, ``batch_norm_with_relu``-style fused BN, and the
+entropy calibration helper behind ``MXQuantizeSymbol``).
+
+TPU-first notes: everything is static-shape; the pooling family builds
+its sampling grids with ``jnp.arange`` outer products (one gather per
+roi, vmapped over rois) rather than per-pixel scalar kernels; hawkesll
+is a ``lax.scan`` over the event sequence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# ---------------------------------------------------------------------------
+# count sketch (compact bilinear pooling building block)
+# ---------------------------------------------------------------------------
+
+
+@register("count_sketch", aliases=("_contrib_count_sketch",))
+def count_sketch(data, h, s, out_dim=0, processing_batch_size=32):
+    """Count-sketch projection (reference: ``count_sketch.cu``
+    ``_contrib_count_sketch``): out[n, h[i]] += s[i] * data[n, i] with
+    hash bucket ``h`` (ints in [0, out_dim)) and signs ``s`` (+-1).
+    One scatter-add on TPU instead of the reference's atomic kernel."""
+    n, in_dim = data.shape
+    hh = h.reshape(-1).astype(jnp.int32)
+    ss = s.reshape(-1).astype(data.dtype)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, hh].add(ss[None, :] * data)
+
+
+# ---------------------------------------------------------------------------
+# Hawkes process log-likelihood
+# ---------------------------------------------------------------------------
+
+
+@register("hawkesll", aliases=("_contrib_hawkesll",))
+def hawkesll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a K-mark Hawkes process with exponential kernels
+    (reference: ``hawkes_ll.cc`` ``_contrib_hawkesll``).
+
+    lda (N,K) background rates, alpha (K,) excitation, beta (K,) decay,
+    state (N,K) the per-mark recursive term r at t=0, lags (N,T)
+    inter-arrival times, marks (N,T) int mark ids, valid_length (N,),
+    max_time (N,) observation horizon. Returns (ll (N,), state_out (N,K)).
+
+    Compensator: LL = sum_i log(lda_{m_i} + alpha_{m_i} beta_{m_i} r_{m_i})
+    - max_time * sum_k lda_k - sum_i alpha_{m_i}(1 - exp(-beta_{m_i}
+    (max_time - t_i))), with r(i+1) = exp(-beta * d_{i+1}) (r(i) +
+    onehot(m_i)) — the standard O(T) recursion, here one ``lax.scan``.
+    """
+    lda = jnp.asarray(lda, jnp.float32)
+    alpha = jnp.asarray(alpha, jnp.float32)
+    beta = jnp.asarray(beta, jnp.float32)
+    K = lda.shape[1]
+    T = lags.shape[1]
+    marks_i = marks.astype(jnp.int32)
+    t_abs = jnp.cumsum(lags, axis=1)  # event times
+
+    def one(lda_n, r0, lags_n, marks_n, vl, mt, t_n):
+        def step(carry, inp):
+            ll, r = carry
+            i, d, m = inp
+            valid = i < vl
+            # padded steps (i >= valid_length) must not touch the state:
+            # the returned state threads into the NEXT observation window
+            r = jnp.where(valid, r * jnp.exp(-beta * d), r)
+            lam = lda_n[m] + alpha[m] * beta[m] * r[m]
+            ll = ll + jnp.where(valid, jnp.log(jnp.maximum(lam, 1e-30)), 0.0)
+            # compensator contribution of event m at absolute time t
+            t = t_n[i]
+            comp = alpha[m] * (1.0 - jnp.exp(-beta[m] * jnp.maximum(mt - t, 0.0)))
+            ll = ll - jnp.where(valid, comp, 0.0)
+            r = jnp.where(valid, r + (jnp.arange(K) == m), r)
+            return (ll, r), None
+
+        (ll, r), _ = lax.scan(
+            step, (jnp.float32(0.0), r0),
+            (jnp.arange(T), lags_n, marks_n))
+        ll = ll - mt * jnp.sum(lda_n)
+        return ll, r
+
+    ll, state_out = jax.vmap(one)(lda, jnp.asarray(state, jnp.float32),
+                                  jnp.asarray(lags, jnp.float32), marks_i,
+                                  valid_length.astype(jnp.int32),
+                                  jnp.asarray(max_time, jnp.float32), t_abs)
+    return ll, state_out
+
+
+# ---------------------------------------------------------------------------
+# R-FCN / Mask-RCNN pooling family
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_at(img, ys, xs):
+    """img (C, H, W); ys/xs flat coords -> (C, len)"""
+    C, H, W = img.shape
+    y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    wy = jnp.clip(ys, 0, H - 1) - y0
+    wx = jnp.clip(xs, 0, W - 1) - x0
+    v00 = img[:, y0, x0]
+    v01 = img[:, y0, x1]
+    v10 = img[:, y1, x0]
+    v11 = img[:, y1, x1]
+    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+
+@register("PSROIPooling", aliases=("_contrib_PSROIPooling",
+                                  "psroipooling"))
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=0,
+                  pooled_size=7, group_size=0):
+    """Position-sensitive ROI pooling (R-FCN; reference:
+    ``psroi_pooling.cc``): input channels = output_dim * group^2; output
+    bin (i, j) of channel c average-pools input channel
+    c*group^2 + gi*group + gj over the bin's cells."""
+    N, C, H, W = data.shape
+    p = pooled_size
+    g = group_size if group_size > 0 else p
+    sr = 2  # samples per bin axis
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ys = (y1 + (jnp.arange(p)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                    / sr) * rh / p).reshape(-1)            # (p*sr,)
+        xs = (x1 + (jnp.arange(p)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+                    / sr) * rw / p).reshape(-1)
+        grid_y = jnp.repeat(ys, p * sr)                    # (p*sr*p*sr,)
+        grid_x = jnp.tile(xs, p * sr)
+        sampled = _bilinear_at(data[b], grid_y, grid_x)    # (C, p*sr*p*sr)
+        sampled = sampled.reshape(C, p, sr, p, sr).mean(axis=(2, 4))  # C,p,p
+        grouped = sampled.reshape(output_dim, g, g, p, p)
+        gi = jnp.clip((jnp.arange(p) * g) // p, 0, g - 1)
+        return grouped[:, gi[:, None], gi[None, :],
+                       jnp.arange(p)[:, None], jnp.arange(p)[None, :]]
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("DeformablePSROIPooling",
+          aliases=("_contrib_DeformablePSROIPooling",))
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=0, pooled_size=7, group_size=0,
+                             part_size=0, sample_per_part=2, trans_std=0.1,
+                             no_trans=False):
+    """Deformable PS-ROI pooling (Deformable ConvNets; reference:
+    ``deformable_psroi_pooling.cc``): each bin's sampling window is
+    shifted by a learned normalized offset from ``trans``
+    (N_rois, 2*part^2 reshaped (n, 2, part, part))."""
+    N, C, H, W = data.shape
+    p = pooled_size
+    g = group_size if group_size > 0 else p
+    part = part_size if part_size > 0 else p
+    sr = max(sample_per_part, 1)
+
+    if no_trans or trans is None:
+        return psroi_pooling(data, rois, spatial_scale, output_dim, p, g)
+
+    def one_roi(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = (roi[1] * spatial_scale, roi[2] * spatial_scale,
+                          roi[3] * spatial_scale, roi[4] * spatial_scale)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        # per-bin offset from the (2, part, part) transform map
+        pi = jnp.clip((jnp.arange(p) * part) // p, 0, part - 1)
+        dy = tr[0][pi[:, None], pi[None, :]] * trans_std * rh   # (p, p)
+        dx = tr[1][pi[:, None], pi[None, :]] * trans_std * rw
+
+        iy = (y1 + (jnp.arange(p)[:, None, None] + 0.5) * rh / p
+              + dy[:, :, None]
+              + ((jnp.arange(sr) + 0.5) / sr - 0.5)[None, None, :]
+              * rh / p)                                          # (p, p, sr)
+        ix = (x1 + (jnp.arange(p)[None, :, None] + 0.5) * rw / p
+              + dx[:, :, None]
+              + ((jnp.arange(sr) + 0.5) / sr - 0.5)[None, None, :]
+              * rw / p)
+        gy = jnp.repeat(iy.reshape(p, p, sr, 1), sr, axis=3)
+        gx = jnp.repeat(ix.reshape(p, p, 1, sr), sr, axis=2)
+        sampled = _bilinear_at(data[b], gy.reshape(-1), gx.reshape(-1))
+        sampled = sampled.reshape(C, p, p, sr, sr).mean(axis=(3, 4))
+        grouped = sampled.reshape(output_dim, g, g, p, p)
+        gi = jnp.clip((jnp.arange(p) * g) // p, 0, g - 1)
+        return grouped[:, gi[:, None], gi[None, :],
+                       jnp.arange(p)[:, None], jnp.arange(p)[None, :]]
+
+    tr = trans.reshape(trans.shape[0], 2, part, part)
+    return jax.vmap(one_roi)(rois, tr)
+
+
+@register("RROIAlign", aliases=("_contrib_RROIAlign",))
+def rroi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+               sampling_ratio=2):
+    """Rotated ROI align (reference: ``rroi_align.cc``): rois are
+    (batch_idx, cx, cy, w, h, angle_degrees); the sampling grid is the
+    box's rotated coordinate frame."""
+    N, C, H, W = data.shape
+    ph, pw = pooled_size
+    sr = max(sampling_ratio, 1)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1e-3)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1e-3)
+        theta = roi[5] * jnp.pi / 180.0
+        cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+        # local coords in [-0.5, 0.5] of the box, sub-sampled sr x sr
+        ly = ((jnp.arange(ph)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+               / sr) / ph - 0.5).reshape(-1) * rh
+        lx = ((jnp.arange(pw)[:, None] + (jnp.arange(sr)[None, :] + 0.5)
+               / sr) / pw - 0.5).reshape(-1) * rw
+        gy = jnp.repeat(ly, pw * sr)
+        gx = jnp.tile(lx, ph * sr)
+        wy = cy + gx * sin_t + gy * cos_t
+        wx = cx + gx * cos_t - gy * sin_t
+        sampled = _bilinear_at(data[b], wy, wx)
+        return sampled.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("mrcnn_mask_target", aliases=("_contrib_mrcnn_mask_target",))
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets,
+                      num_rois=0, mask_size=(14, 14), num_classes=0,
+                      sample_ratio=2):
+    """Mask-RCNN mask targets (reference: ``mrcnn_mask_target.cu``):
+    crop each roi's matched ground-truth mask and resize to
+    ``mask_size``; the class weight tensor is one-hot at the roi's
+    class. rois (B, N, 4) corners, gt_masks (B, M, H, W),
+    matches (B, N) gt index, cls_targets (B, N) class id (0 =
+    background). Returns (mask_targets (B, N, C, mh, mw), mask_cls same
+    shape)."""
+    B, N = matches.shape[:2]
+    mh, mw = mask_size
+    Hm, Wm = gt_masks.shape[-2:]
+
+    def one_image(rois_i, masks_i, match_i, cls_i):
+        def one_roi(roi, m_idx):
+            mask = masks_i[jnp.clip(m_idx.astype(jnp.int32), 0,
+                                    masks_i.shape[0] - 1)]
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            ys = y1 + (jnp.arange(mh) + 0.5) / mh * (y2 - y1)
+            xs = x1 + (jnp.arange(mw) + 0.5) / mw * (x2 - x1)
+            gy = jnp.repeat(ys, mw)
+            gx = jnp.tile(xs, mh)
+            return _bilinear_at(mask[None], gy, gx).reshape(mh, mw)
+
+        crops = jax.vmap(one_roi)(rois_i, match_i)            # (N, mh, mw)
+        cls = cls_i.astype(jnp.int32)
+        onehot = (jnp.arange(num_classes)[None, :] == cls[:, None])
+        targets = crops[:, None, :, :] * onehot[:, :, None, None]
+        weights = jnp.broadcast_to(
+            (onehot & (cls > 0)[:, None])[:, :, None, None],
+            (N, num_classes, mh, mw))
+        return targets, weights.astype(rois_i.dtype)
+
+    t, w = jax.vmap(one_image)(rois, gt_masks, matches, cls_targets)
+    return t, w
+
+
+# ---------------------------------------------------------------------------
+# fused BN+ReLU and batched proposals
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNormWithReLU", aliases=("_contrib_BatchNormWithReLU",))
+def batch_norm_with_relu(data, gamma, beta, moving_mean, moving_var,
+                         eps=1e-3, momentum=0.9, fix_gamma=True,
+                         use_global_stats=False, output_mean_var=False,
+                         axis=1, training=False, **kw):
+    """BatchNorm with fused ReLU (reference: the BatchNormWithReLU
+    fused op). XLA fuses the max(0, .) into the normalize anyway — the
+    op exists for graph-level parity. Same contract as ``batch_norm``:
+    in training mode the result carries (out, new_mean, new_var) so the
+    nd wrapper (ndarray/op.py BatchNormWithReLU) can write back the
+    moving stats."""
+    from .nn import batch_norm
+
+    res = batch_norm(data, gamma, beta, moving_mean, moving_var, eps=eps,
+                     momentum=momentum, fix_gamma=fix_gamma,
+                     use_global_stats=use_global_stats,
+                     output_mean_var=True, axis=axis, training=training,
+                     **kw)
+    out, mean, var = res[0], res[1], res[2]
+    out = jnp.maximum(out, 0)
+    if training or output_mean_var:
+        return (out, mean, var) + tuple(res[3:])
+    return out
+
+
+def _register_multi_proposal():
+    from .registry import _OPS
+
+    _OPS["MultiProposal"] = _OPS["Proposal"]
+    _OPS["_contrib_MultiProposal"] = _OPS["Proposal"]
+
+
+_register_multi_proposal()
+# (reference MultiProposal = Proposal over a batch of images;
+#  ops/detection.py Proposal is already vmapped over the batch dim)
+
+
+# ---------------------------------------------------------------------------
+# entropy (KL) calibration for int8 quantization
+# ---------------------------------------------------------------------------
+
+
+@register("calibrate_entropy", aliases=("_contrib_calibrate_entropy",),
+          jit=False)
+def calibrate_entropy(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence-minimizing activation threshold (reference:
+    ``calibrate.cc`` / ``quantization.py`` ``_get_optimal_threshold`` —
+    the TensorRT-style entropy calibration behind
+    ``calib_mode='entropy'``).
+
+    hist/hist_edges: a SYMMETRIC histogram of activations (the reference
+    uses 8001 bins). Returns (opt_threshold (1,), divergence (1,)).
+
+    The load-bearing detail: the candidate P carries the clipped outside
+    mass in its edge bins while Q is requantized from the UNclipped
+    slice — the mass mismatch is exactly what penalizes aggressive
+    clipping, so flat distributions keep the full range while
+    outlier-heavy ones clip. Host-side numpy, vectorized with
+    ``np.add.reduceat``; runs once at calibration time."""
+    import numpy as onp
+
+    hist = onp.asarray(hist, onp.float64)
+    edges = onp.asarray(hist_edges, onp.float64)
+    num_bins = hist.size
+    zero_bin = num_bins // 2
+    nqb = num_quantized_bins
+    best_div, best_thr = onp.inf, float(edges[-1])
+
+    def smooth(d, eps=0.0001):
+        is_zero = d == 0
+        n_zero = is_zero.sum()
+        n_nonzero = d.size - n_zero
+        if n_nonzero == 0:
+            return None
+        eps1 = eps * n_zero / n_nonzero
+        out = d.astype(onp.float64).copy()
+        out[is_zero] = eps
+        out[~is_zero] -= eps1 * out[~is_zero].clip(max=1.0)
+        return out
+
+    for i in range(nqb // 2, zero_bin + 1):
+        lo, hi = zero_bin - i, zero_bin + i + 1
+        sliced = hist[lo:hi]
+        p = sliced.copy()
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        nonzero = sliced != 0
+        merged = sliced.size // nqb
+        if merged == 0:
+            continue
+        starts = onp.arange(nqb) * merged
+        qbins = onp.add.reduceat(sliced, starts)
+        qbins[-1] += sliced[nqb * merged:].sum()
+        # expand each bucket's mass evenly over its NONZERO bins
+        nz_counts = onp.add.reduceat(nonzero.astype(onp.float64), starts)
+        # last bucket swallows the remainder bins
+        if sliced.size > nqb * merged:
+            nz_counts[-1] = nonzero[starts[-1]:].sum()
+        lengths = onp.diff(onp.append(starts, sliced.size))
+        avg = onp.where(nz_counts > 0, qbins / onp.maximum(nz_counts, 1), 0.0)
+        q = onp.repeat(avg, lengths) * nonzero
+        ps = smooth(p)
+        qs = smooth(q)
+        if ps is None or qs is None:
+            continue
+        ps = ps / ps.sum()
+        qs = qs / qs.sum()
+        div = float((ps * onp.log(ps / qs)).sum())
+        if div < best_div:
+            best_div = div
+            hi_edge = min(hi, edges.size - 1)
+            best_thr = float(edges[hi_edge])
+    import jax.numpy as _jnp
+
+    return (_jnp.asarray([best_thr], _jnp.float32),
+            _jnp.asarray([best_div if onp.isfinite(best_div) else 0.0],
+                         _jnp.float32))

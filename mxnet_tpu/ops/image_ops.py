@@ -139,7 +139,6 @@ def random_brightness(data, min_factor=1.0, max_factor=1.0):
 def _img_mean(x, c_ax):
     """Per-IMAGE gray mean: reduce H, W, C but keep the batch axis."""
     g = _gray(x, c_ax)
-    axes = tuple(range(x.ndim - 3, x.ndim)) if x.ndim == 4 else None
     if x.ndim == 4:
         return g.mean(axis=(1, 2, 3), keepdims=True)
     return g.mean()

@@ -1,0 +1,126 @@
+"""Contrib tranche 2 (reference: contrib/count_sketch.cu, hawkes_ll.cc,
+psroi_pooling.cc, deformable_psroi_pooling.cc, rroi_align.cc,
+mrcnn_mask_target.cu, multi_proposal.cc): forward semantics vs numpy
+oracles."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+nd = mx.nd
+
+
+def test_count_sketch_oracle():
+    rng = np.random.RandomState(0)
+    data = rng.randn(2, 5).astype(np.float32)
+    h = np.array([0, 2, 1, 2, 0])
+    s = np.array([1.0, -1.0, 1.0, 1.0, -1.0], np.float32)
+    out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                                  out_dim=3).asnumpy()
+    want = np.zeros((2, 3), np.float32)
+    for i in range(5):
+        want[:, h[i]] += s[i] * data[:, i]
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_hawkesll_oracle():
+    rng = np.random.RandomState(1)
+    N, T, K = 2, 4, 3
+    lda = rng.rand(N, K).astype(np.float32) + 0.5
+    alpha = rng.rand(K).astype(np.float32) * 0.5
+    beta = rng.rand(K).astype(np.float32) + 1.0
+    lags = rng.rand(N, T).astype(np.float32)
+    marks = rng.randint(0, K, (N, T)).astype(np.float32)
+    vl = np.array([4.0, 2.0], np.float32)
+    mt = np.array([5.0, 3.0], np.float32)
+    ll, state = nd.contrib.hawkesll(
+        nd.array(lda), nd.array(alpha), nd.array(beta), nd.zeros((N, K)),
+        nd.array(lags), nd.array(marks), nd.array(vl), nd.array(mt))
+    for n in range(N):
+        r = np.zeros(K)
+        llw, t = 0.0, 0.0
+        for i in range(T):
+            t += lags[n, i]
+            r = r * np.exp(-beta * lags[n, i])
+            if i < vl[n]:
+                m = int(marks[n, i])
+                llw += np.log(lda[n, m] + alpha[m] * beta[m] * r[m])
+                llw -= alpha[m] * (1 - np.exp(-beta[m] * max(mt[n] - t, 0)))
+                r[m] += 1
+        llw -= mt[n] * lda[n].sum()
+        assert abs(float(ll.asnumpy()[n]) - llw) < 1e-3
+
+
+def test_psroi_pooling():
+    C_out, p = 2, 3
+    # constant per channel-group: output bin must read its OWN group
+    data = np.zeros((1, C_out * p * p, 8, 8), np.float32)
+    for c in range(C_out * p * p):
+        data[0, c] = c
+    rois = np.array([[0.0, 1.0, 1.0, 7.0, 7.0]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=C_out,
+                                  pooled_size=p).asnumpy()
+    assert out.shape == (1, C_out, p, p)
+    for c in range(C_out):
+        for i in range(p):
+            for j in range(p):
+                assert out[0, c, i, j] == c * p * p + i * p + j
+
+
+def test_deformable_psroi_pooling_zero_offsets_match_psroi():
+    rng = np.random.RandomState(0)
+    C_out, p = 2, 3
+    data = rng.rand(1, C_out * p * p, 8, 8).astype(np.float32)
+    rois = np.array([[0.0, 1.0, 1.0, 7.0, 7.0]], np.float32)
+    base = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                   output_dim=C_out, pooled_size=p).asnumpy()
+    trans = np.zeros((1, 2 * p * p), np.float32)
+    dp = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans), output_dim=C_out,
+        pooled_size=p, part_size=p, sample_per_part=2).asnumpy()
+    np.testing.assert_allclose(dp, base, rtol=1e-4, atol=1e-5)
+    # no_trans path ignores the offsets entirely
+    nt = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans * 100),
+        output_dim=C_out, pooled_size=p, no_trans=True).asnumpy()
+    np.testing.assert_allclose(nt, base, rtol=1e-4, atol=1e-5)
+
+
+def test_rroi_align_rotation():
+    img = np.arange(64.0, dtype=np.float32).reshape(1, 1, 8, 8)
+    # angle 0: axis-aligned window around the center
+    roi0 = np.array([[0.0, 4.0, 4.0, 4.0, 4.0, 0.0]], np.float32)
+    o0 = nd.contrib.RROIAlign(nd.array(img), nd.array(roi0),
+                              pooled_size=(2, 2)).asnumpy()
+    assert o0.shape == (1, 1, 2, 2)
+    # 180 degrees flips both axes of the sampled window
+    roi180 = np.array([[0.0, 4.0, 4.0, 4.0, 4.0, 180.0]], np.float32)
+    o180 = nd.contrib.RROIAlign(nd.array(img), nd.array(roi180),
+                                pooled_size=(2, 2)).asnumpy()
+    np.testing.assert_allclose(o180[0, 0], o0[0, 0, ::-1, ::-1], atol=1e-3)
+
+
+def test_mrcnn_mask_target():
+    rois = np.array([[[0.0, 0.0, 8.0, 8.0], [2.0, 2.0, 6.0, 6.0]]],
+                    np.float32)
+    gt = np.zeros((1, 2, 8, 8), np.float32)
+    gt[0, 0, :, :4] = 1.0  # mask 0: left half on
+    matches = np.array([[0.0, 1.0]], np.float32)
+    cls = np.array([[1.0, 0.0]], np.float32)
+    t, w = nd.contrib.mrcnn_mask_target(
+        nd.array(rois), nd.array(gt), nd.array(matches), nd.array(cls),
+        num_rois=2, mask_size=(4, 4), num_classes=3)
+    t, w = t.asnumpy(), w.asnumpy()
+    assert t.shape == (1, 2, 3, 4, 4) and w.shape == t.shape
+    # roi 0 (class 1): left columns of the crop are on
+    assert t[0, 0, 1, :, 0].min() > 0.5 and t[0, 0, 1, :, -1].max() < 0.5
+    # weights: one-hot at class 1 for roi 0; background roi 1 all-zero
+    assert w[0, 0, 1].all() and not w[0, 0, 0].any() and not w[0, 1].any()
+
+
+def test_multi_proposal_is_batched_proposal():
+    assert nd.contrib.MultiProposal is not None
+    from mxnet_tpu.ops.registry import get
+
+    assert get("MultiProposal") is get("Proposal")

@@ -142,3 +142,75 @@ def test_transforms_geometric():
     np.testing.assert_array_equal(
         transforms.RandomFlipLeftRight(1.0)(img).asnumpy(),
         img.asnumpy()[:, ::-1])
+
+
+# ---------------------------------------------------------------------------
+# gluon.contrib.data.text (reference: contrib/data/text.py)
+# ---------------------------------------------------------------------------
+
+
+def test_corpus_dataset_next_token_layout(tmp_path):
+    from mxnet_tpu.gluon.contrib.data import CorpusDataset
+
+    f = tmp_path / "c.txt"
+    f.write_text("a b c d e f g h\n")
+    ds = CorpusDataset(str(f), seq_len=3)
+    x, y = ds[0]
+    # label is data shifted one token left (next-token prediction)
+    ids = ds.vocabulary.to_indices("a b c d e f g h".split() + ["<eos>"])
+    assert x.asnumpy().tolist() == ids[:3]
+    assert y.asnumpy().tolist() == ids[1:4]
+    assert len(ds) == (9 - 1) // 3
+
+
+def test_wikitext_local_files_and_loader(tmp_path):
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.data import WikiText2
+
+    words = "the quick brown fox jumps over the lazy dog".split()
+    text = "\n".join(" ".join(words) for _ in range(20))
+    (tmp_path / "wiki.train.tokens").write_text(text)
+    (tmp_path / "wiki.valid.tokens").write_text(text)
+    train = WikiText2(root=str(tmp_path), segment="train", seq_len=5)
+    # validation reuses the train vocabulary (reference behavior)
+    val = WikiText2(root=str(tmp_path), segment="validation", seq_len=5,
+                    vocab=train.vocabulary)
+    assert val.vocabulary is train.vocabulary
+    loader = gluon.data.DataLoader(train, batch_size=4, last_batch="discard")
+    xb, yb = next(iter(loader))
+    assert xb.shape == (4, 5) and yb.shape == (4, 5)
+    # ids in range for an Embedding of vocab size
+    assert int(xb.asnumpy().max()) < len(train.vocabulary)
+
+
+def test_wikitext_missing_files_raise(tmp_path):
+    import pytest as _pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon.contrib.data import WikiText103
+
+    with _pytest.raises(MXNetError, match="token file"):
+        WikiText103(root=str(tmp_path))
+
+
+def test_transforms_reproducible_under_seed():
+    """Photometric transforms route through the _image_* ops and the
+    framework key stream, so mx.random.seed pins the augmentation."""
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    aug = transforms.Compose([
+        transforms.RandomFlipLeftRight(),
+        transforms.RandomColorJitter(brightness=0.4, contrast=0.3,
+                                     saturation=0.3, hue=0.1),
+        transforms.RandomLighting(0.1),
+    ])
+    x = mx.nd.array(np.random.RandomState(0)
+                    .randint(0, 255, (8, 8, 3)).astype(np.float32))
+    mx.random.seed(11)
+    a = aug(x).asnumpy()
+    mx.random.seed(11)
+    b = aug(x).asnumpy()
+    np.testing.assert_allclose(a, b)
+    mx.random.seed(12)
+    c = aug(x).asnumpy()
+    assert not np.allclose(a, c)

@@ -111,3 +111,11 @@ def test_library_errors(native_lib):
     # infer_shape failure surfaces as MXNetError (k mismatch)
     with pytest.raises(mx.base.MXNetError):
         mx.nd.my_gemm(mx.nd.ones((2, 3)), mx.nd.ones((4, 5)))
+
+
+def test_library_op_available_in_symbol_api(native_lib):
+    mx.library.load(native_lib, verbose=False)
+    s = mx.sym.my_relu6(mx.sym.var("x"))
+    ex = s.simple_bind(x=(3,))
+    out = ex.forward(x=mx.nd.array([-1.0, 3.0, 9.0]))[0]
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 3.0, 6.0])

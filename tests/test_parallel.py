@@ -250,3 +250,34 @@ def test_run_steps_matches_python_loop():
     _ = b.run_steps(mx.nd.array(X), mx.nd.array(Y), 6, lr=0.1)
     _ = b.run_steps(mx.nd.array(X), mx.nd.array(Y), 3, lr=0.1)
     assert b._run_many is not None
+
+
+def test_sync_batchnorm_global_stats_across_shards():
+    """SyncBatchNorm semantics under SPMD: stats are computed over the
+    GLOBAL batch even when the batch is sharded over dp (reference:
+    contrib SyncBatchNorm; here GSPMD inserts the cross-device reduction)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon.contrib import nn as contrib_nn
+
+    mesh = parallel.make_mesh({"dp": 8})
+    net = contrib_nn.SyncBatchNorm(in_channels=3)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    # shards with wildly different means: per-shard BN would differ from
+    # global BN by construction
+    host = np.concatenate(
+        [rng.rand(2, 3, 4, 4).astype(np.float32) + 10 * k for k in range(8)])
+    x = mx.nd.NDArray(parallel.shard_batch(host, mesh))
+    for _, prm in net.collect_params().items():
+        prm.set_data(mx.nd.NDArray(parallel.replicate(prm.data(), mesh)))
+    with autograd.record():
+        y = net(x)
+    got = y.asnumpy()
+    mean = host.mean(axis=(0, 2, 3), keepdims=True)
+    var = host.var(axis=(0, 2, 3), keepdims=True)
+    want = (host - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    # moving stats saw the global mean too
+    np.testing.assert_allclose(
+        net.running_mean.data().asnumpy(),
+        0.1 * mean.ravel(), rtol=1e-3)

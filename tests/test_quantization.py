@@ -164,3 +164,33 @@ def test_quantize_net_rejects_unsupported():
     _ = net(mx.nd.ones((1, 4)))
     with pytest.raises(MXNetError):
         quantize_net(net, calib_data=[mx.nd.ones((1, 4))])
+
+
+def test_quantize_net_entropy_calibration():
+    """calib_mode='entropy' (reference calibrate.cc): accuracy comparable
+    to naive min/max on a conv net with outlier activations."""
+    rng = np.random.RandomState(0)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=3),
+            nn.Activation("relu"), nn.Flatten(), nn.Dense(4))
+    net.initialize()
+    x = rng.rand(64, 3, 8, 8).astype(np.float32)
+    x[0, 0, 0, 0] = 40.0  # outlier that wrecks a pure min/max range
+    ref = net(mx.nd.array(x)).asnumpy()
+    from mxnet_tpu.contrib import quantization
+
+    qnet = quantization.quantize_net(net, calib_data=[mx.nd.array(x)],
+                                     calib_mode="entropy")
+    got = qnet(mx.nd.array(x)).asnumpy()
+    # entropy calibration trades the outlier sample for resolution on the
+    # bulk: non-outlier rows must be accurate, and tighter than naive
+    err = np.abs(got[1:] - ref[1:]).max() / (np.abs(ref[1:]).max() + 1e-6)
+    assert err < 0.2, err
+    qnaive = quantization.quantize_net(net, calib_data=[mx.nd.array(x)],
+                                       calib_mode="naive")
+    gn = qnaive(mx.nd.array(x)).asnumpy()
+    err_naive = np.abs(gn[1:] - ref[1:]).max() / (np.abs(ref[1:]).max() + 1e-6)
+    assert err <= err_naive + 1e-6, (err, err_naive)
+    with pytest.raises(mx.base.MXNetError):
+        quantization.quantize_net(net, calib_data=[mx.nd.array(x)],
+                                  calib_mode="bogus")
