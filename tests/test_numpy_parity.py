@@ -317,3 +317,18 @@ def test_inplace_np_outside_record_preserves_lineage():
         np.fill_diagonal(y, 0.0)
     s.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), onp.full((3, 3), 2.0))
+
+
+def test_npx_save_load_waitall_use_np(tmp_path):
+    f = str(tmp_path / "arrs.params")
+    npx.save(f, {"w": np.ones((2, 3)), "b": np.zeros(4)})
+    back = npx.load(f)
+    assert set(back) == {"w", "b"}
+    assert onp.allclose(back["w"].asnumpy(), 1.0)
+    npx.save(f, [np.arange(5)])
+    lst = npx.load(f)
+    assert onp.allclose(lst[0].asnumpy(), onp.arange(5))
+    npx.waitall()
+    # namespace hygiene: no camelCase or loop-variable leaks
+    assert not hasattr(npx, "batchNorm") and not hasattr(npx, "low")
+    assert callable(npx.batch_norm) and callable(npx.use_np)

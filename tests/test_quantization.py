@@ -194,3 +194,33 @@ def test_quantize_net_entropy_calibration():
     with pytest.raises(mx.base.MXNetError):
         quantization.quantize_net(net, calib_data=[mx.nd.array(x)],
                                   calib_mode="bogus")
+
+
+def test_intgemm_family():
+    """intgemm int8 GEMM surface (reference: contrib/intgemm/*.cc): the
+    prepared format on TPU is plain int8 (MXU-native), math matches fp32
+    within int8 tolerance."""
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 8).astype(np.float32))
+    w = mx.nd.array(rng.randn(5, 8).astype(np.float32))
+    sx = mx.nd.contrib.intgemm_maxabsolute(x)
+    sw = mx.nd.contrib.intgemm_maxabsolute(w)
+    np.testing.assert_allclose(sx.asnumpy()[0],
+                               np.abs(x.asnumpy()).max(), rtol=1e-6)
+    qx = mx.nd.contrib.intgemm_prepare_data(x, sx)
+    qw = mx.nd.contrib.intgemm_prepare_weight(w, sw)
+    assert qx.dtype == np.int8 and qw.dtype == np.int8
+    scaling = float(sx.asnumpy()[0]) * float(sw.asnumpy()[0]) / (127.0 ** 2)
+    out = mx.nd.contrib.intgemm_fully_connected(qx, qw, mx.nd.array(scaling),
+                                                num_hidden=5)
+    ref = x.asnumpy() @ w.asnumpy().T
+    err = np.abs(out.asnumpy() - ref).max() / np.abs(ref).max()
+    assert err < 0.03, err
+    # int32 accumulator output + row selection
+    acc = mx.nd.contrib.intgemm_fully_connected(qx, qw, out_type="int32")
+    assert acc.dtype == np.int32
+    sel = mx.nd.contrib.intgemm_take_weight(qw, mx.nd.array([0, 2]))
+    np.testing.assert_array_equal(sel.asnumpy(), qw.asnumpy()[[0, 2]])
+    # already-quantized weights pass through
+    qw2 = mx.nd.contrib.intgemm_prepare_weight(qw, already_quantized=True)
+    np.testing.assert_array_equal(qw2.asnumpy(), qw.asnumpy())
