@@ -72,7 +72,8 @@ def dgl_adjacency(csr):
 def dgl_subgraph(graph, *vids, return_mapping=False):
     """Vertex-induced subgraphs (reference: ``DGLSubgraphForward``):
     for each vertex-id array, the induced adjacency re-labelled to local
-    ids, plus (optionally) the original edge ids in the same layout."""
+    ids, plus (optionally) the original edge ids PLUS ONE in the same
+    layout (0 is the no-edge sentinel; DGL edge ids are 0-based)."""
     indptr, indices, data = _csr_parts(graph)
     outs = []
     mappings = []
@@ -89,7 +90,9 @@ def dgl_subgraph(graph, *vids, return_mapping=False):
                 lj = local.get(int(rj))
                 if lj is not None:
                     sub[li, lj] = 1.0
-                    emap[li, lj] = e
+                    # ids stored +1 (0 = no edge; DGL ids are 0-based —
+                    # same convention as _neighbor_sample)
+                    emap[li, lj] = e + 1.0
         outs.append(jnp.asarray(sub))
         mappings.append(jnp.asarray(emap))
     res = outs + (mappings if return_mapping else [])
@@ -107,7 +110,8 @@ def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
         int(jax.random.randint(key, (), 0, 2 ** 31 - 1)))
     seed_ids = onp.asarray(seeds).astype(onp.int64).ravel()
     seed_ids = seed_ids[seed_ids >= 0]
-    visited = list(dict.fromkeys(seed_ids.tolist()))
+    # the padded output holds at most max_num_vertices ids
+    visited = list(dict.fromkeys(seed_ids.tolist()))[:max_num_vertices]
     frontier = list(visited)
     edges = {}  # (u, v) -> edge id
     for _ in range(max(num_hops, 1)):
@@ -132,7 +136,8 @@ def _neighbor_sample(graph, seeds, num_hops, num_neighbor,
                 v = int(row[s])
                 edges[(u, v)] = float(dat[s])
                 nxt.append(v)
-        new = [v for v in dict.fromkeys(nxt) if v not in set(visited)]
+        vset = set(visited)
+        new = [v for v in dict.fromkeys(nxt) if v not in vset]
         room = max_num_vertices - len(visited)
         new = new[:max(room, 0)]
         visited.extend(new)

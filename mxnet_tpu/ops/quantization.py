@@ -261,6 +261,12 @@ def intgemm_fully_connected(data, weight, scaling_or_bias=None, bias=None,
         (((a.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)
     if out_type == "int32":
+        if bias is not None:
+            from ..base import MXNetError
+
+            raise MXNetError("intgemm_fully_connected: a float bias "
+                             "cannot be added to the raw int32 "
+                             "accumulator; use out_type='float32'")
         return acc
     out = acc.astype(jnp.float32) * scaling
     if bias is not None:

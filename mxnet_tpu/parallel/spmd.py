@@ -25,16 +25,33 @@ from ..gluon.block import _TRACE_STATE
 from ..ndarray.ndarray import NDArray
 
 
+def _put_global(raw, sharding):
+    """Build a global array under ``sharding`` with each PROCESS serving
+    its own addressable shards from ``raw`` (device_put would need
+    cross-host transfers on a multi-process mesh, which CPU/DCN-less
+    backends refuse). On a single process this degenerates to a plain
+    sharded placement."""
+    import numpy as onp
+
+    host = onp.asarray(raw)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])
+
+
 def shard_batch(arr, mesh, axis_name="dp"):
-    """Place a host batch sharded along its leading axis."""
+    """Place a host batch sharded along its leading axis. On a
+    multi-process mesh every process passes an array of the GLOBAL batch
+    shape and contributes the rows its devices own (identical arrays
+    everywhere -> the natural single-program semantics; per-rank data ->
+    the global batch is the concatenation of each rank's owned rows)."""
     raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
     sharding = NamedSharding(mesh, P(axis_name, *([None] * (raw.ndim - 1))))
-    return jax.device_put(raw, sharding)
+    return _put_global(raw, sharding)
 
 
 def replicate(arr, mesh):
     raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
-    return jax.device_put(raw, NamedSharding(mesh, P()))
+    return _put_global(raw, NamedSharding(mesh, P()))
 
 
 def _state_dtype(w):
@@ -233,14 +250,14 @@ class SPMDTrainStep:
         for n, h, d in zip(names, handles, diff):
             raw = h.data
             if self.mesh is not None:
-                raw = jax.device_put(raw, self._sharding_for(n, raw))
+                # per-process shard feeding (works across hosts) + a fresh
+                # buffer: the compiled step DONATES its param buffers, and
+                # a donated alias of the Gluon handle's array kills it (a
+                # second step on the same block then dies with "Array has
+                # been deleted")
+                raw = _put_global(raw, self._sharding_for(n, raw))
             else:
-                raw = jax.device_put(raw, commit_dev)
-            # the compiled step DONATES its param buffers; device_put is a
-            # no-copy alias when the layout already matches, and a donated
-            # alias kills the Gluon handle's array (a second step on the
-            # same block then dies with "Array has been deleted")
-            raw = jnp.copy(raw)
+                raw = jnp.copy(jax.device_put(raw, commit_dev))
             params.append(raw)
             if not d:
                 opt_states.append(())
@@ -255,7 +272,7 @@ class SPMDTrainStep:
                 for leaf in state)
             if self.mesh is not None:
                 state = tuple(
-                    jax.device_put(leaf, NamedSharding(self.mesh, sp))
+                    _put_global(leaf, NamedSharding(self.mesh, sp))
                     for leaf, sp in zip(state, leaf_specs))
             else:
                 state = tuple(jax.device_put(leaf, commit_dev)
@@ -494,6 +511,23 @@ def spmd_load_states(step, prefix):
     files = sorted(_glob.glob(f"{prefix}.shard*.npz"))
     if not files:
         raise MXNetError(f"no checkpoint shards match {prefix}.shard*.npz")
+    # local-shard index map per tensor: only chunks overlapping THIS
+    # process's shards are decompressed (the whole point of the sharded
+    # format — no host materializes the full state)
+    def _local_spans(like):
+        spans = []
+        for idx in like.sharding.addressable_devices_indices_map(
+                like.shape).values():
+            spans.append(tuple(
+                (0 if sl.start is None else sl.start,
+                 dim if sl.stop is None else sl.stop)
+                for sl, dim in zip(idx, like.shape)))
+        return spans
+
+    wanted = {}
+    for key, raw in _iter_state_tensors(step):
+        wanted[key] = _local_spans(raw)
+
     chunks = {}
     for f in files:
         with onp.load(f) as z:
@@ -501,6 +535,12 @@ def spmd_load_states(step, prefix):
                 name, _, spans = k.rpartition("|")
                 idx = tuple(slice(int(a), int(b)) for a, b in
                             (s.split(":") for s in spans.split(";") if s))
+                local = wanted.get(name)
+                if local is not None and idx:
+                    src = [(sl.start, sl.stop) for sl in idx]
+                    if not any(all(sb > ta and sa < tb for (sa, sb), (ta, tb)
+                                   in zip(src, tgt)) for tgt in local):
+                        continue  # chunk entirely on other hosts
                 chunks.setdefault(name, []).append((idx, z[k]))
     params, opt_states = step._state
     new_params = []

@@ -78,3 +78,28 @@ def test_fm_sparse_dist_training():
                         res.stdout)
     assert len(checks) == 2, res.stdout[-2000:]
     assert checks[0][1] == checks[1][1], checks  # bit-identical params
+
+
+CKPT_WORKER = os.path.join(ROOT, "tests", "distributed", "ckpt_worker.py")
+
+
+def test_sharded_checkpoint_multiprocess(tmp_path):
+    """spmd_save_states/load_states across 2 REAL processes: each rank
+    writes only its addressable shards (ZeRO moments split), restore is
+    bit-exact on every rank."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    env["MXTPU_TEST_CKPT_DIR"] = str(tmp_path)
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, CKPT_WORKER],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
+        f"stderr:\n{res.stderr[-4000:]}")
+    for rank in range(2):
+        assert f"CKPT_WORKER_OK rank={rank}/2" in res.stdout, res.stdout[-2000:]

@@ -31,9 +31,11 @@ def test_dgl_subgraph():
     dense, g = _graph()
     sub, emap = mx.nd.contrib.dgl_subgraph(g, mx.nd.array([0, 2]),
                                            return_mapping=True)
-    # induced on {0, 2}: edges 0->2 (id 2) and 2->0 (id 4)
+    # induced on {0, 2}: edges 0->2 (id 2) and 2->0 (id 4); the mapping
+    # stores id+1 so DGL's legal edge id 0 survives the 0=no-edge dense
+    # encoding
     np.testing.assert_allclose(sub.asnumpy(), [[0, 1], [1, 0]])
-    np.testing.assert_allclose(emap.asnumpy(), [[0, 2], [4, 0]])
+    np.testing.assert_allclose(emap.asnumpy(), [[0, 3], [5, 0]])
     # two vid sets in one call
     s1, s2 = mx.nd.contrib.dgl_subgraph(g, mx.nd.array([0, 1]),
                                         mx.nd.array([1, 2, 3]))

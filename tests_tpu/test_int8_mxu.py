@@ -1,0 +1,61 @@
+"""int8 compute on the real MXU: intgemm + quantized_* ops execute on the
+chip with int32 accumulation and match fp32 within int8 tolerance."""
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_intgemm_fully_connected_on_tpu():
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(64, 256).astype(np.float32))
+    w = mx.nd.array(rng.randn(128, 256).astype(np.float32))
+    sx = mx.nd.contrib.intgemm_maxabsolute(x)
+    sw = mx.nd.contrib.intgemm_maxabsolute(w)
+    qx = mx.nd.contrib.intgemm_prepare_data(x, sx)
+    qw = mx.nd.contrib.intgemm_prepare_weight(w, sw)
+    scale = float(sx.asnumpy()[0]) * float(sw.asnumpy()[0]) / 127.0 ** 2
+    out = mx.nd.contrib.intgemm_fully_connected(qx, qw, mx.nd.array(scale),
+                                                num_hidden=128)
+    ref = x.asnumpy() @ w.asnumpy().T
+    rel = np.abs(out.asnumpy() - ref).max() / np.abs(ref).max()
+    assert rel < 0.05, rel
+    acc = mx.nd.contrib.intgemm_fully_connected(qx, qw, out_type="int32")
+    assert acc.dtype == np.int32
+    # int32 accumulation is exact for the int8 operands
+    qxn = qx.asnumpy().astype(np.int32)
+    qwn = qw.asnumpy().astype(np.int32)
+    np.testing.assert_array_equal(acc.asnumpy(), qxn @ qwn.T)
+
+
+def test_quantized_conv_on_tpu():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 8, 16, 16).astype(np.float32)
+    w = rng.randn(16, 8, 3, 3).astype(np.float32) * 0.1
+    from mxnet_tpu.ndarray import op as ndop
+
+    qx, minx, maxx = ndop.quantize_v2(mx.nd.array(x))
+    qw, minw, maxw = ndop.quantize_v2(mx.nd.array(w))
+    out, omin, omax = ndop.quantized_conv(
+        qx, qw, None, minx, maxx, minw, maxw,
+        kernel=(3, 3), num_filter=16, pad=(1, 1), no_bias=True)
+    assert out.dtype == np.int32
+    from jax import lax
+    import jax.numpy as jnp
+
+    ref = np.asarray(lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))))
+    # int32 accumulators dequantize with the product of the two int8
+    # scales (quantize_net's convention; `dequantize` itself is the
+    # int8->float op)
+    def _sc(lo, hi):
+        return max(abs(float(np.asarray(lo.asnumpy()).ravel()[0])),
+                   abs(float(np.asarray(hi.asnumpy()).ravel()[0]))) / 127.0
+
+    sx = _sc(minx, maxx)
+    sw = _sc(minw, maxw)
+    deq = out.asnumpy().astype(np.float32) * sx * sw
+    rel = np.abs(deq - ref).max() / np.abs(ref).max()
+    assert rel < 0.08, rel
