@@ -222,6 +222,27 @@ def bench_flash_attention(backend):
           mfu=(tflops / peak) if peak else None,
           pallas=bool(fa._HAS_PALLAS and fa._use_pallas(D)))
 
+    if backend != "cpu":
+        # long-context: sliding-window (Mistral-style) attention at 32k —
+        # the banded Pallas kernels skip out-of-band block COMPUTE, so
+        # FLOPs are O(T*W) not O(T^2) (grid/DMA still walk all cells)
+        Tl, W = 32768, 1024
+        ql = jnp.asarray(np.random.randn(1, H, Tl, D), jnp.bfloat16)
+        kl = jnp.asarray(np.random.randn(1, H, Tl, D), jnp.bfloat16)
+        vl = jnp.asarray(np.random.randn(1, H, Tl, D), jnp.bfloat16)
+
+        def fstep_w(x):
+            # forward (the long-context inference path; the Pallas bwd
+            # caps at T=8k — see flash_attention._PALLAS_BWD_MAX_T)
+            return fa.flash_attention(x, kl, vl, window=W, block_size=1024)
+
+        per_w = chain_time_per_iter(fstep_w, ql, 3, 12, reps=2)
+        # band area ~= T*W (minus the triangular ramp-in, negligible)
+        flops_w = 2 * 2 * 1 * H * Tl * W * D
+        _emit(f"flash_attention_sldwin_fwd_T{Tl}_W{W}_D{D}_{backend}",
+              flops_w / per_w / 1e12, "TFLOP/s", None,
+              step_ms=per_w * 1e3, window=W)
+
 
 def bench_allreduce(backend):
     import jax

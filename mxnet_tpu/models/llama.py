@@ -55,13 +55,14 @@ def _rope(x, base=500000.0):
 
 class LlamaAttention(HybridBlock):
     def __init__(self, units, num_heads, num_kv_heads, rope_base=500000.0,
-                 **kwargs):
+                 sliding_window=0, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._h = num_heads
         self._kvh = num_kv_heads
         self._d = units // num_heads
         self._rope_base = rope_base
+        self._window = sliding_window
         with self.name_scope():
             self.q_proj = nn.Dense(units, flatten=False, use_bias=False,
                                    prefix="q_")
@@ -87,7 +88,9 @@ class LlamaAttention(HybridBlock):
             rep = H // KVH
             k = NDArray(jnp.repeat(k.data, rep, axis=1), ctx=x.ctx)
             v = NDArray(jnp.repeat(v.data, rep, axis=1), ctx=x.ctx)
-        out = F.flash_attention(q, k, v, causal=True)
+        # sliding_window > 0 selects the banded Pallas kernels
+        # (Mistral-style local attention, O(T*W) instead of O(T^2))
+        out = F.flash_attention(q, k, v, causal=True, window=self._window)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, T, C))
         return self.o_proj(out)
 
@@ -113,12 +116,14 @@ def _silu(F, x):
 
 class LlamaDecoderLayer(HybridBlock):
     def __init__(self, units, intermediate, num_heads, num_kv_heads,
-                 rope_base, **kwargs):
+                 rope_base, sliding_window=0, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.input_layernorm = RMSNorm(units, prefix="in_ln_")
             self.self_attn = LlamaAttention(units, num_heads, num_kv_heads,
-                                            rope_base, prefix="attn_")
+                                            rope_base,
+                                            sliding_window=sliding_window,
+                                            prefix="attn_")
             self.post_attention_layernorm = RMSNorm(units, prefix="post_ln_")
             self.mlp = LlamaMLP(units, intermediate, prefix="mlp_")
 
@@ -131,11 +136,12 @@ class LlamaDecoderLayer(HybridBlock):
 class LlamaModel(HybridBlock):
     def __init__(self, vocab_size=128256, num_layers=32, units=4096,
                  intermediate=14336, num_heads=32, num_kv_heads=8,
-                 rope_base=500000.0, **kwargs):
+                 rope_base=500000.0, sliding_window=0, **kwargs):
         super().__init__(**kwargs)
         self._cfg = dict(vocab_size=vocab_size, num_layers=num_layers,
                          units=units, intermediate=intermediate,
-                         num_heads=num_heads, num_kv_heads=num_kv_heads)
+                         num_heads=num_heads, num_kv_heads=num_kv_heads,
+                         sliding_window=sliding_window)
         with self.name_scope():
             self.embed_tokens = nn.Embedding(vocab_size, units,
                                              prefix="embed_")
@@ -144,7 +150,8 @@ class LlamaModel(HybridBlock):
                 for i in range(num_layers):
                     self.layers.add(LlamaDecoderLayer(
                         units, intermediate, num_heads, num_kv_heads,
-                        rope_base, prefix=f"l{i}_"))
+                        rope_base, sliding_window=sliding_window,
+                        prefix=f"l{i}_"))
             self.norm = RMSNorm(units, prefix="norm_")
             self.lm_head = nn.Dense(vocab_size, flatten=False, use_bias=False,
                                     prefix="lm_head_")

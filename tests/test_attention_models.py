@@ -187,3 +187,40 @@ def test_interleaved_matches_flash():
                                  mx.nd.array(v)).asnumpy()
     out2 = np.transpose(out2, (2, 0, 1, 3)).reshape(T, N, H * D)
     np.testing.assert_allclose(out1, out2, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attention_sliding_window_cpu_oracle():
+    """window>0 (Mistral-style local attention): fwd and grads match a
+    dense-masked softmax reference on the CPU path."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import flash_attention as fa
+
+    rng = np.random.RandomState(0)
+    B, H, T, D, W = 1, 2, 64, 16, 12
+    q = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, T, D), jnp.float32)
+    mask = np.tril(np.ones((T, T), bool)) \
+        & (np.arange(T)[:, None] - np.arange(T)[None, :] < W)
+
+    def dense(q_, k_, v_):
+        s = jnp.einsum("bhtd,bhsd->bhts", q_, k_) / np.sqrt(D)
+        s = jnp.where(jnp.asarray(mask), s, -1e30)
+        return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, axis=-1), v_)
+
+    out = fa.flash_attention(q, k, v, window=W, block_size=16)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense(q, k, v)),
+                               rtol=1e-4, atol=1e-5)
+    for argnum in range(3):
+        g1 = jax.grad(lambda *a: jnp.sum(
+            fa.flash_attention(*a, window=W, block_size=16) ** 2),
+            argnums=argnum)(q, k, v)
+        g2 = jax.grad(lambda *a: jnp.sum(dense(*a) ** 2),
+                      argnums=argnum)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-3, atol=1e-4)
+    # window attention requires self-attention shapes
+    with pytest.raises(ValueError):
+        fa.flash_attention(q, k[:, :, :32], v[:, :, :32], window=W)
