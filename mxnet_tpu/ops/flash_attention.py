@@ -334,7 +334,8 @@ _PALLAS_BWD_MAX_T = 8192
 
 def _flash_bwd_rule(scale, causal, block_size, window, res, g):
     q, k, v, out, lse = res
-    if _pallas_ready(q, k, causal, block_size)             and q.shape[2] <= _PALLAS_BWD_MAX_T:
+    if (_pallas_ready(q, k, causal, block_size)
+            and q.shape[2] <= _PALLAS_BWD_MAX_T):
         return _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal,
                                  bq=block_size, bk=block_size, window=window)
     B, H, T, D = q.shape
@@ -403,6 +404,8 @@ def flash_attention(query, key, value, scale=None, causal=False,
     dense op-surface analog."""
     if scale is None:
         scale = 1.0 / (query.shape[-1] ** 0.5)
+    if window and window < 0:
+        raise ValueError(f"window must be >= 0 (0 disables); got {window}")
     if window and window > 0:
         causal = True
         if query.shape[2] != key.shape[2]:

@@ -233,3 +233,86 @@ def chain_time_per_iter(step_fn, init, n1=5, n2=40, reps=3):
         return min(ts)
 
     return (chain(n2) - chain(n1)) / (n2 - n1)
+
+
+def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
+                           ctx=None, **bind_kwargs):
+    """Bind a symbol, run forward, compare each output against
+    ``expected`` (reference: ``test_utils.check_symbolic_forward``).
+
+    location: list of arrays (positional, matched to list_arguments) or
+    a name->array dict. expected: list of numpy arrays."""
+    import numpy as onp
+
+    from .ndarray.ndarray import NDArray, array
+
+    args = sym.list_arguments()
+    if isinstance(location, dict):
+        feed = {k: (v if isinstance(v, NDArray) else array(v))
+                for k, v in location.items()}
+    else:
+        feed = {n: (v if isinstance(v, NDArray) else array(v))
+                for n, v in zip(args, location)}
+    ex = sym.simple_bind(ctx=ctx, **{n: tuple(v.shape)
+                                     for n, v in feed.items()},
+                         **bind_kwargs)
+    outs = ex.forward(**feed)
+    assert len(outs) == len(expected), (len(outs), len(expected))
+    for o, e in zip(outs, expected):
+        assert_almost_equal(o.asnumpy(), onp.asarray(e), rtol=rtol,
+                            atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=1e-4, atol=1e-6, grad_req="write",
+                            ctx=None):
+    """Bind, forward+backward with ``out_grads``, compare each argument
+    gradient (reference: ``test_utils.check_symbolic_backward``)."""
+    import numpy as onp
+
+    from .ndarray.ndarray import NDArray, array
+
+    args = sym.list_arguments()
+    if isinstance(location, dict):
+        feed = {k: (v if isinstance(v, NDArray) else array(v))
+                for k, v in location.items()}
+    else:
+        feed = {n: (v if isinstance(v, NDArray) else array(v))
+                for n, v in zip(args, location)}
+    ex = sym.simple_bind(ctx=ctx, grad_req=grad_req,
+                         **{n: tuple(v.shape) for n, v in feed.items()})
+    ex.forward(is_train=True, **feed)
+    ogs = [g if isinstance(g, NDArray) else array(g) for g in
+           (out_grads if isinstance(out_grads, (list, tuple))
+            else [out_grads])]
+    ex.backward(ogs)
+    if isinstance(expected, dict):
+        items = expected.items()
+    else:
+        items = zip(args, expected)
+    for name, e in items:
+        if e is None:
+            continue
+        got = ex.grad_dict[name].asnumpy()
+        assert_almost_equal(got, onp.asarray(e), rtol=rtol, atol=atol)
+    return ex.grad_dict
+
+
+def same_symbol_structure(sym1, sym2):
+    """True when two symbols have identical graph structure — op types,
+    topology, and attrs — ignoring node names (reference:
+    ``test_utils.same_symbol_structure``)."""
+    import json
+
+    def canon(s):
+        g = json.loads(s.tojson())
+        nodes = []
+        for n in g.get("nodes", []):
+            inputs = [[e[0], e[1]] for e in n.get("inputs", [])]
+            nodes.append((n.get("op"), tuple(sorted(
+                (k, str(v)) for k, v in (n.get("attrs") or {}).items())),
+                tuple(map(tuple, inputs))))
+        return nodes
+
+    return canon(sym1) == canon(sym2)

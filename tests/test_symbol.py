@@ -105,3 +105,30 @@ def test_get_internals():
     y = sym.relu(h, name="act")
     internals = y.get_internals()
     assert any("fc1" in str(s.name) for s in internals._inputs)
+
+
+def test_check_symbolic_helpers():
+    """test_utils.check_symbolic_forward/backward + same_symbol_structure
+    (reference: python/mxnet/test_utils.py)."""
+    from mxnet_tpu import test_utils as tu
+
+    net = sym.FullyConnected(sym.var("x"), sym.var("w"), None,
+                             no_bias=True, num_hidden=3)
+    xd = np.random.rand(2, 4).astype(np.float32)
+    wd = np.random.rand(3, 4).astype(np.float32)
+    tu.check_symbolic_forward(net, {"x": xd, "w": wd}, [xd @ wd.T])
+    og = np.random.rand(2, 3).astype(np.float32)
+    tu.check_symbolic_backward(net, {"x": xd, "w": wd}, [og],
+                               {"x": og @ wd, "w": og.T @ xd})
+    same = sym.FullyConnected(sym.var("a"), sym.var("b"), None,
+                              no_bias=True, num_hidden=3)
+    other = sym.FullyConnected(sym.var("a"), sym.var("b"), None,
+                               no_bias=True, num_hidden=5)
+    assert tu.same_symbol_structure(net, same)
+    assert not tu.same_symbol_structure(net, other)
+    # a wrong expectation must raise
+    import pytest as _pytest
+
+    with _pytest.raises(AssertionError):
+        tu.check_symbolic_forward(net, {"x": xd, "w": wd},
+                                  [np.zeros((2, 3), np.float32)])

@@ -129,18 +129,21 @@ def test_pallas_sliding_window_vs_oracle(T, W, bs):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-2, atol=2e-2)
 
-    def loss_pallas(x):
-        return jnp.sum(fa.flash_attention(x, k, v, window=W,
+    def loss_pallas(qq, kk, vv):
+        return jnp.sum(fa.flash_attention(qq, kk, vv, window=W,
                                           block_size=bs).astype(jnp.float32))
 
-    def loss_oracle(x):
-        o, _ = fa._jnp_flash_fwd(x, k, v, 1.0 / D ** 0.5, True, W)
+    def loss_oracle(qq, kk, vv):
+        o, _ = fa._jnp_flash_fwd(qq, kk, vv, 1.0 / D ** 0.5, True, W)
         return jnp.sum(o.astype(jnp.float32))
 
-    g1 = jax.grad(loss_pallas)(q)
-    g2 = jax.grad(loss_oracle)(q)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
-                               rtol=5e-2, atol=5e-2)
+    # all three operand grads exercise the banded dq AND dk/dv scratch
+    # accumulation paths of the Pallas backward
+    for argnum in range(3):
+        g1 = jax.grad(loss_pallas, argnums=argnum)(q, k, v)
+        g2 = jax.grad(loss_oracle, argnums=argnum)(q, k, v)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=5e-2, atol=5e-2)
 
 
 def test_pallas_window_faster_than_full_at_long_T():
