@@ -199,7 +199,10 @@ def bench_flash_attention(backend):
     from mxnet_tpu.ops import flash_attention as fa
 
     B, H, T, D = (2, 8, 4096, 64) if backend != "cpu" else (1, 2, 256, 32)
-    n1, n2 = (5, 30) if backend != "cpu" else (1, 3)
+    # long chains: at ~1-3 ms/iter the two-point slope needs a few
+    # hundred ms of spread or relay RTT jitter dominates (observed 28-122
+    # TFLOP/s scatter with (5, 30))
+    n1, n2 = (20, 180) if backend != "cpu" else (1, 3)
     q = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
     k = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
     v = jnp.asarray(np.random.randn(B, H, T, D), jnp.bfloat16)
@@ -212,7 +215,7 @@ def bench_flash_attention(backend):
                            .astype(jnp.float32))
         return jax.grad(loss)(x).astype(x.dtype)
 
-    per_step = chain_time_per_iter(gstep, q, n1, n2, reps=2)
+    per_step = chain_time_per_iter(gstep, q, n1, n2, reps=3)
     # causal: half the T^2 blocks; fwd 2 matmuls + FA2 bwd 5 => 3.5x fwd pair
     flops_step = 3.5 * (2 * 2 * B * H * T * T * D) / 2
     tflops = flops_step / per_step / 1e12
@@ -236,7 +239,7 @@ def bench_flash_attention(backend):
             # caps at T=8k — see flash_attention._PALLAS_BWD_MAX_T)
             return fa.flash_attention(x, kl, vl, window=W, block_size=1024)
 
-        per_w = chain_time_per_iter(fstep_w, ql, 3, 12, reps=2)
+        per_w = chain_time_per_iter(fstep_w, ql, 10, 60, reps=3)
         # band area ~= T*W (minus the triangular ramp-in, negligible)
         flops_w = 2 * 2 * 1 * H * Tl * W * D
         _emit(f"flash_attention_sldwin_fwd_T{Tl}_W{W}_D{D}_{backend}",

@@ -42,8 +42,8 @@ _BINARY_ALIASES = {
     "broadcast_div": ("elemwise_div", "divide", "_div"),
     "broadcast_mod": ("_mod",),
     "broadcast_power": ("_power", "pow"),
-    "broadcast_maximum": ("maximum", "_maximum"),
-    "broadcast_minimum": ("minimum", "_minimum"),
+    "broadcast_maximum": ("maximum", "_maximum", "broadcast_max"),
+    "broadcast_minimum": ("minimum", "_minimum", "broadcast_min"),
 }
 
 for _name, _fn in _BINARY.items():
