@@ -215,7 +215,7 @@ def bench_flash_attention(backend):
                            .astype(jnp.float32))
         return jax.grad(loss)(x).astype(x.dtype)
 
-    per_step = chain_time_per_iter(gstep, q, n1, n2, reps=3)
+    per_step = chain_time_per_iter(gstep, q, n1, n2)
     # causal: half the T^2 blocks; fwd 2 matmuls + FA2 bwd 5 => 3.5x fwd pair
     flops_step = 3.5 * (2 * 2 * B * H * T * T * D) / 2
     tflops = flops_step / per_step / 1e12
@@ -239,7 +239,7 @@ def bench_flash_attention(backend):
             # caps at T=8k — see flash_attention._PALLAS_BWD_MAX_T)
             return fa.flash_attention(x, kl, vl, window=W, block_size=1024)
 
-        per_w = chain_time_per_iter(fstep_w, ql, 10, 60, reps=3)
+        per_w = chain_time_per_iter(fstep_w, ql, 10, 60)
         # band area ~= T*W (minus the triangular ramp-in, negligible)
         flops_w = 2 * 2 * 1 * H * Tl * W * D
         _emit(f"flash_attention_sldwin_fwd_T{Tl}_W{W}_D{D}_{backend}",
