@@ -394,6 +394,12 @@ def flash_attention(query, key, value, scale=None, causal=False,
     sequences. 1024x1024 bf16 q/k/v/o blocks + f32 accumulators fit
     v5e VMEM (~16 MB) at D<=128.
 
+    Grouped-query attention: callers repeat kv heads to H before the
+    kernel (``models/llama.py``); a native GQA BlockSpec (kv index_map
+    ``b -> b // group``) would save the repeat's HBM traffic in the
+    forward — future work, the backward's dk/dv cross-group
+    accumulation does not fit the consecutive-revisit rule.
+
     ``window > 0`` selects sliding-window (Mistral/Longformer-style
     local causal) attention: position i sees the last ``window``
     positions only. Both Pallas kernels SKIP the compute of every block
