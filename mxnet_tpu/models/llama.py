@@ -84,10 +84,10 @@ class LlamaAttention(HybridBlock):
                         axes=(0, 2, 1, 3))
         q = NDArray(_rope(q.data, self._rope_base), ctx=x.ctx)
         k = NDArray(_rope(k.data, self._rope_base), ctx=x.ctx)
-        if KVH != H:  # grouped-query: repeat kv heads
-            rep = H // KVH
-            k = NDArray(jnp.repeat(k.data, rep, axis=1), ctx=x.ctx)
-            v = NDArray(jnp.repeat(v.data, rep, axis=1), ctx=x.ctx)
+        # grouped-query kv heads (KVH < H) go to the op unrepeated; the
+        # op's default path repeats kv internally (fastest measured), and
+        # flash_attention(native_gqa=True) exists for long-context runs
+        # where the O(H) kv repeat in HBM is the binding constraint
         # sliding_window > 0 selects the banded Pallas kernels
         # (Mistral-style local attention, O(T*W) instead of O(T^2))
         out = F.flash_attention(q, k, v, causal=True, window=self._window)

@@ -46,9 +46,14 @@ def _use_pallas(d):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
-                      kv_blocks, window=0):
+                      kv_blocks, window=0, true_t=0):
+    """``true_t > 0`` = grouped-query mode: the q rows are G stacked
+    heads of a TRUE sequence length ``true_t`` (the wrapper guarantees
+    bq | true_t, so a block never straddles heads); masks use the row's
+    position WITHIN its head, ``global_row % true_t``."""
     ki = pl.program_id(2)
     qi = pl.program_id(1)
+    q_pos0 = (qi * bq) % true_t if true_t else qi * bq
 
     @pl.when(ki == 0)
     def _init():
@@ -63,7 +68,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bk)
         if causal or window > 0:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             ok = rows >= cols
             if window > 0:  # sliding window: see only the last W positions
@@ -83,9 +88,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     if causal or window > 0:
         # skip blocks entirely above the diagonal, and (windowed) blocks
         # entirely below the band
-        cond = ki * bk <= qi * bq + bq - 1
+        cond = ki * bk <= q_pos0 + bq - 1
         if window > 0:
-            cond = cond & (ki * bk + bk - 1 >= qi * bq - window + 1)
+            cond = cond & (ki * bk + bk - 1 >= q_pos0 - window + 1)
 
         @pl.when(cond)
         def _():
@@ -113,18 +118,29 @@ except Exception:  # pragma: no cover
 
 def _pallas_flash_fwd(q, k, v, scale, causal, bq=512, bk=512, window=0):
     B, H, T, D = q.shape
+    KVH = k.shape[1]
     S = k.shape[2]
+    group = H // KVH
+    if group > 1:
+        # native grouped-query: fold each kv head's G query heads into
+        # the sequence axis (one kernel row per KV head — k/v are fetched
+        # ONCE per group instead of being repeated in HBM). bq | T keeps
+        # every block inside one head; masks use row % T.
+        qr = q.reshape(B * KVH, group * T, D)
+        true_t, t_eff = T, group * T
+    else:
+        qr = q.reshape(B * H, T, D)
+        true_t, t_eff = 0, T
     bq = min(bq, T)
     bk = min(bk, S)
     assert T % bq == 0 and S % bk == 0, "seq lens must divide block sizes"
-    qr = q.reshape(B * H, T, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
+    kr = k.reshape(B * KVH, S, D)
+    vr = v.reshape(B * KVH, S, D)
     kv_blocks = S // bk
-    grid = (B * H, T // bq, kv_blocks)
+    grid = (B * KVH, t_eff // bq, kv_blocks)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, kv_blocks=kv_blocks,
-                               window=window)
+                               window=window, true_t=true_t)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -138,8 +154,8 @@ def _pallas_flash_fwd(q, k, v, scale, causal, bq=512, bk=512, window=0):
             pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+            jax.ShapeDtypeStruct((qr.shape[0], t_eff, D), q.dtype),
+            jax.ShapeDtypeStruct((qr.shape[0], t_eff, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -151,10 +167,15 @@ def _pallas_flash_fwd(q, k, v, scale, causal, bq=512, bk=512, window=0):
 
 
 def _pallas_ready(q, k, causal, block_size):
-    """True when the Pallas kernel handles these shapes (else jnp path)."""
+    """True when the Pallas kernel handles these shapes (else jnp path).
+    Grouped-query (fewer kv heads) is native as long as the head counts
+    divide; the q block is clamped to the TRUE sequence length so the
+    flattened-group layout never straddles heads."""
+    bq = min(block_size, q.shape[2])
     return (_HAS_PALLAS and _use_pallas(q.shape[-1])
             and (not causal or q.shape[2] == k.shape[2])
-            and q.shape[2] % min(block_size, q.shape[2]) == 0
+            and q.shape[1] % k.shape[1] == 0
+            and q.shape[2] % bq == 0
             and k.shape[2] % min(block_size, k.shape[2]) == 0)
 
 
@@ -165,7 +186,8 @@ def _pallas_ready(q, k, causal, block_size):
 
 def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, dk_ref, dv_ref, dq_scr, dk_scr, dv_scr, *,
-                      scale, causal, bq, bk, q_blocks, kv_blocks, window=0):
+                      scale, causal, bq, bk, q_blocks, kv_blocks, window=0,
+                      true_t=0):
     """Fused FA2-style backward: one pass over (kv_block, q_block) computes
     s/p once and emits all three grads. ALL accumulation happens in VMEM
     scratch — dk/dv over the consecutive q (fast) axis, dq in a full
@@ -176,6 +198,7 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     batch-head row (its (1, T, d) window is current for that whole row)."""
     qi = pl.program_id(2)
     ki = pl.program_id(1)
+    q_pos0 = (qi * bq) % true_t if true_t else qi * bq
 
     @pl.when(qi == 0)
     def _init_kv():
@@ -196,7 +219,7 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if causal or window > 0:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
             ok = rows >= cols
             if window > 0:
@@ -218,9 +241,9 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal or window > 0:
-        cond = qi * bq + bq - 1 >= ki * bk
+        cond = q_pos0 + bq - 1 >= ki * bk
         if window > 0:
-            cond = cond & (ki * bk + bk - 1 >= qi * bq - window + 1)
+            cond = cond & (ki * bk + bk - 1 >= q_pos0 - window + 1)
 
         @pl.when(cond)
         def _():
@@ -241,17 +264,27 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
                       window=0):
     B, H, T, D = q.shape
+    KVH = k.shape[1]
     S = k.shape[2]
+    group = H // KVH
     bq = min(bq, T)
     bk = min(bk, S)
-    qr = q.reshape(B * H, T, D)
-    kr = k.reshape(B * H, S, D)
-    vr = v.reshape(B * H, S, D)
-    gr = g.reshape(B * H, T, D)
-    lse_r = lse.reshape(B * H, T, 1)
-    delta = jnp.sum(gr.astype(jnp.float32) * out.reshape(B * H, T, D)
-                    .astype(jnp.float32), axis=-1, keepdims=True)  # (BH,T,1)
-    q_blocks, kv_blocks = T // bq, S // bk
+    if group > 1:
+        # grouped-query (see _pallas_flash_fwd): q-side tensors fold the
+        # group into the sequence axis; dk/dv then accumulate over ALL
+        # of a kv head's query heads through the ordinary qi sweep
+        true_t, t_eff = T, group * T
+    else:
+        true_t, t_eff = 0, T
+    qr = q.reshape(B * KVH, t_eff, D)
+    kr = k.reshape(B * KVH, S, D)
+    vr = v.reshape(B * KVH, S, D)
+    gr = g.reshape(B * KVH, t_eff, D)
+    lse_r = lse.reshape(B * KVH, t_eff, 1)
+    delta = jnp.sum(gr.astype(jnp.float32)
+                    * out.reshape(B * KVH, t_eff, D).astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (B*KVH, t_eff, 1)
+    q_blocks, kv_blocks = t_eff // bq, S // bk
 
     # grid: (batch, kv_block, q_block) — q is the fast (reduction) axis
     q_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
@@ -260,16 +293,17 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
     dq, dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, q_blocks=q_blocks,
-                          kv_blocks=kv_blocks, window=window),
-        grid=(B * H, kv_blocks, q_blocks),
+                          kv_blocks=kv_blocks, window=window,
+                          true_t=true_t),
+        grid=(B * KVH, kv_blocks, q_blocks),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
-        out_specs=[pl.BlockSpec((1, T, D), lambda b, j, i: (b, 0, 0)),
+        out_specs=[pl.BlockSpec((1, t_eff, D), lambda b, j, i: (b, 0, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-                   jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((T, D), jnp.float32),
+        out_shape=[jax.ShapeDtypeStruct((B * KVH, t_eff, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * KVH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * KVH, S, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((t_eff, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
     )(qr, kr, vr, gr, lse_r, delta)
@@ -307,21 +341,41 @@ def _jnp_flash_fwd(q, k, v, scale, causal, window=0):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention_core(q, k, v, scale, causal, block_size, window=0):
-    out, _ = _fwd_impl(q, k, v, scale, causal, block_size, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_core(q, k, v, scale, causal, block_size, window=0,
+                         native_gqa=False):
+    out, _ = _fwd_impl(q, k, v, scale, causal, block_size, window,
+                       native_gqa)
     return out
 
 
-def _fwd_impl(q, k, v, scale, causal, block_size, window=0):
+def _repeat_kv(q, k, v):
+    """Expand grouped kv heads to the full head count (non-Pallas paths)."""
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return k, v
+
+
+def _fwd_impl(q, k, v, scale, causal, block_size, window=0, native_gqa=False):
     if _pallas_ready(q, k, causal, block_size):
-        return _pallas_flash_fwd(q, k, v, scale, causal,
+        # default: repeat kv for the kernel — measured 3x FASTER than the
+        # flattened native-GQA layout at H32/KVH8/T4k (0.61 vs 1.91 ms
+        # fwd; Mosaic pipelines the static-offset kernel much better than
+        # the dynamic row%T variant). native_gqa=True trades that for
+        # O(KVH) kv HBM at very long contexts.
+        kf, vf = (k, v) if native_gqa else _repeat_kv(q, k, v)
+        return _pallas_flash_fwd(q, kf, vf, scale, causal,
                                  bq=block_size, bk=block_size, window=window)
-    return _jnp_flash_fwd(q, k, v, scale, causal, window)
+    kf, vf = _repeat_kv(q, k, v)
+    return _jnp_flash_fwd(q, kf, vf, scale, causal, window)
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_size, window=0):
-    out, lse = _fwd_impl(q, k, v, scale, causal, block_size, window)
+def _flash_fwd_rule(q, k, v, scale, causal, block_size, window=0,
+                    native_gqa=False):
+    out, lse = _fwd_impl(q, k, v, scale, causal, block_size, window,
+                         native_gqa)
     return out, (q, k, v, out, lse)
 
 
@@ -332,10 +386,25 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_size, window=0):
 _PALLAS_BWD_MAX_T = 8192
 
 
-def _flash_bwd_rule(scale, causal, block_size, window, res, g):
+def _flash_bwd_rule(scale, causal, block_size, window, native_gqa, res, g):
     q, k, v, out, lse = res
+    group = q.shape[1] // k.shape[1]
+    use_native = (native_gqa and group > 1
+                  and _pallas_ready(q, k, causal, block_size)
+                  and group * q.shape[2] <= _PALLAS_BWD_MAX_T)
+    if group > 1 and not use_native:
+        # default GQA path (also the fallback when the native backward's
+        # flattened q exceeds the VMEM cap): run the grad on repeated kv,
+        # fold dk/dv back down over the group
+        kf, vf = _repeat_kv(q, k, v)
+        dq, dkf, dvf = _flash_bwd_rule(scale, causal, block_size, window,
+                                       False, (q, kf, vf, out, lse), g)
+        B, KVH, S, D = k.shape
+        dk = dkf.reshape(B, KVH, group, S, D).sum(axis=2).astype(k.dtype)
+        dv = dvf.reshape(B, KVH, group, S, D).sum(axis=2).astype(v.dtype)
+        return dq, dk, dv
     if (_pallas_ready(q, k, causal, block_size)
-            and q.shape[2] <= _PALLAS_BWD_MAX_T):
+            and group * q.shape[2] <= _PALLAS_BWD_MAX_T):
         return _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal,
                                  bq=block_size, bk=block_size, window=window)
     B, H, T, D = q.shape
@@ -385,7 +454,7 @@ flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 @register("flash_attention", aliases=("_contrib_flash_attention",))
 def flash_attention(query, key, value, scale=None, causal=False,
-                    block_size=1024, window=0):
+                    block_size=1024, window=0, native_gqa=False):
     """Memory-efficient attention. query/key/value: (B, H, T, D).
 
     block_size sweep on v5e (fwd+bwd, T=4k, D=64): 128 -> 7, 256 -> 22,
@@ -394,11 +463,13 @@ def flash_attention(query, key, value, scale=None, causal=False,
     sequences. 1024x1024 bf16 q/k/v/o blocks + f32 accumulators fit
     v5e VMEM (~16 MB) at D<=128.
 
-    Grouped-query attention: callers repeat kv heads to H before the
-    kernel (``models/llama.py``); a native GQA BlockSpec (kv index_map
-    ``b -> b // group``) would save the repeat's HBM traffic in the
-    forward — future work, the backward's dk/dv cross-group
-    accumulation does not fit the consecutive-revisit rule.
+    Grouped-query attention (fewer kv heads, ``KVH | H``) is accepted
+    directly; the default path repeats kv inside the op (measured 3x
+    faster on v5e than the flattened native-GQA kernel layout, whose
+    dynamic row%T offsets pipeline poorly in Mosaic). ``native_gqa=True``
+    opts into the no-repeat kernels — O(KVH) kv HBM instead of O(H),
+    the right trade at very long contexts; both paths are oracle-tested
+    on-chip (tests_tpu).
 
     ``window > 0`` selects sliding-window (Mistral/Longformer-style
     local causal) attention: position i sees the last ``window``
@@ -412,10 +483,14 @@ def flash_attention(query, key, value, scale=None, causal=False,
         scale = 1.0 / (query.shape[-1] ** 0.5)
     if window and window < 0:
         raise ValueError(f"window must be >= 0 (0 disables); got {window}")
+    if query.shape[1] % key.shape[1] != 0:
+        raise ValueError("query heads must be a multiple of kv heads; got "
+                         f"{query.shape[1]} vs {key.shape[1]}")
     if window and window > 0:
         causal = True
         if query.shape[2] != key.shape[2]:
             raise ValueError("window attention expects self-attention "
                              "(T == S)")
     return flash_attention_core(query, key, value, float(scale), bool(causal),
-                                int(block_size), int(window))
+                                int(block_size), int(window),
+                                bool(native_gqa))
