@@ -1,0 +1,44 @@
+"""bench.py must keep working — the driver runs it at the end of every
+round and records the TAIL line as the headline metric. This smoke runs
+the whole suite on the CPU backend (tiny configs, ~40 s) and checks the
+emitted contract."""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_RUNNER = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {root!r})
+import bench
+bench.main()
+"""
+
+
+def test_bench_emits_driver_contract(tmp_path):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    res = subprocess.run(
+        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, res.stderr[-3000:]
+    lines = [ln for ln in res.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert len(lines) >= 5, res.stdout
+    recs = [json.loads(ln) for ln in lines]
+    for rec in recs:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec), rec
+        assert isinstance(rec["value"], (int, float))
+    # the tail line is the ResNet headline the driver records
+    assert recs[-1]["metric"].startswith("resnet50_v1_train"), recs[-1]
+    names = [r["metric"] for r in recs]
+    assert any("bert" in n for n in names)
+    assert any("flash_attention" in n for n in names)
+    assert any("allreduce" in n for n in names)
