@@ -21,7 +21,8 @@ bench.main()
 
 
 def test_bench_emits_driver_contract(tmp_path):
-    env = dict(os.environ)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}  # ambient knobs must not leak in
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "host_platform_device_count" not in f)

@@ -1,0 +1,86 @@
+"""Small reference surfaces with no dedicated tests so far: mx.callback,
+mx.visualization, mx.runtime, mx.name / mx.attribute scopes (reference:
+python/mxnet/{callback,visualization,runtime,name,attribute}.py)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+
+
+def _net():
+    data = sym.var("data")
+    fc1 = sym.FullyConnected(data, sym.var("w1"), sym.var("b1"),
+                             num_hidden=8, name="fc1")
+    act = sym.Activation(fc1, act_type="relu")
+    fc2 = sym.FullyConnected(act, sym.var("w2"), sym.var("b2"),
+                             num_hidden=3, name="fc2")
+    return sym.SoftmaxOutput(fc2, sym.var("softmax_label"), name="softmax")
+
+
+def test_visualization_print_summary(capsys):
+    mx.visualization.print_summary(_net(), shape={"data": (2, 5)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "fc2" in out
+    # per-layer param counts: fc1 = 5*8+8 = 48, fc2 = 8*3+3 = 27
+    assert "48" in out and "27" in out and "Total params:" in out
+
+
+def test_callback_speedometer_and_do_checkpoint(tmp_path, caplog):
+    from mxnet_tpu.callback import BatchEndParam, Speedometer, do_checkpoint
+
+    metric = mx.metric.create("acc")
+    metric.update([mx.nd.array([0, 1])],
+                  [mx.nd.array([[0.9, 0.1], [0.1, 0.9]])])
+    speed = Speedometer(batch_size=4, frequent=1)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(3):
+            speed(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=metric))
+    assert any("Speed" in r.message or "samples/sec" in r.message
+               for r in caplog.records)
+
+    prefix = str(tmp_path / "model")
+    cb = do_checkpoint(prefix, period=1)
+    net = _net()
+    args = {"w1": mx.nd.ones((8, 5)), "b1": mx.nd.zeros((8,)),
+            "w2": mx.nd.ones((3, 8)), "b2": mx.nd.zeros((3,))}
+    cb(0, net, args, {})
+    loaded_sym, loaded_args, _ = mx.model.load_checkpoint(prefix, 1)
+    assert sorted(loaded_args) == sorted(args)
+    np.testing.assert_allclose(loaded_args["w1"].asnumpy(),
+                               args["w1"].asnumpy())
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    # XLA/PJIT/PALLAS are build capabilities (always on); TPU reflects
+    # the LIVE backend and is False on this CPU-forced suite
+    assert feats.is_enabled("XLA") and feats.is_enabled("PJIT")
+    assert feats.is_enabled("PALLAS") and feats.is_enabled("BF16")
+    # reference-named features that are honestly absent report False
+    assert not feats.is_enabled("CUDA")
+    assert not feats.is_enabled("MKLDNN")
+    # liveness: TPU reflects the running backend, False under forced CPU
+    assert not feats.is_enabled("TPU")
+
+
+def test_name_manager_and_attr_scope():
+    mx.name.reset()
+    a = mx.name.next_name("conv")
+    b = mx.name.next_name("conv")
+    assert a != b and a.startswith("conv") and b.startswith("conv")
+    mx.name.reset()
+    assert mx.name.next_name("conv") == a
+
+    from mxnet_tpu.attribute import AttrScope
+
+    with AttrScope(ctx_group="dev1", foo="bar"):
+        attrs = AttrScope.current().get()
+        assert attrs["ctx_group"] == "dev1" and attrs["foo"] == "bar"
+        with AttrScope(foo="baz"):
+            inner = AttrScope.current().get()
+            assert inner["foo"] == "baz" and inner["ctx_group"] == "dev1"
+    assert "foo" not in (AttrScope.current().get() or {})
