@@ -106,13 +106,14 @@ _FUNCS = [
     "polyval", "real", "imag", "conj", "conjugate", "angle",
     # round-3 breadth (auto-skipped when absent from jnp)
     "divmod", "float_power", "frexp", "modf", "logaddexp", "logaddexp2",
-    "i0", "sinc", "isin", "in1d", "intersect1d", "union1d", "setdiff1d",
+    "i0", "sinc", "isin", "intersect1d", "union1d", "setdiff1d",
     "histogram2d", "histogramdd", "bartlett", "blackman", "hamming",
     "hanning", "kaiser", "nanmedian", "nanpercentile", "nanquantile",
     "nancumprod", "select", "piecewise", "rollaxis",
     "trim_zeros", "unwrap", "roots", "polyadd", "polyder", "polyfit",
     "polyint", "polymul", "polysub", "diag_indices_from", "packbits",
-    "unpackbits", "real_if_close", "shares_memory",
+    "unpackbits",
+    "geomspace", "block", "apply_along_axis", "fromfunction", "setxor1d",
 ]
 
 for _n in _FUNCS:
@@ -140,6 +141,68 @@ dtype = _onp.dtype
 # aliases / shims jnp spells differently
 if not hasattr(_THIS, "trapz") and hasattr(_THIS, "trapezoid"):
     trapz = trapezoid  # noqa: F821 - numpy<2 name
+
+row_stack = vstack  # noqa: F821 - numpy legacy name
+
+
+def einsum_path(*operands, **kwargs):
+    """Contraction-order planner (metadata only — MUST bypass the
+    autograd-recording wrapper: its output is a (list, str) pair, not an
+    array, and jax.vjp rejects it)."""
+    return jnp.einsum_path(*(_unwrap(o) for o in operands), **kwargs)
+
+
+def in1d(ar1, ar2, assume_unique=False, invert=False):
+    """numpy-1.x spelling of ``isin`` on the flattened first array."""
+    res = isin(ar1, ar2, invert=invert)  # noqa: F821
+    return res.reshape((-1,))
+
+
+def fromiter(iterable, dtype, count=-1):
+    """Host constructor (reference mx.np mirrors numpy's)."""
+    host = _onp.fromiter(iterable, dtype=dtype, count=count)
+    return array(host)  # noqa: F821
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    host = _onp.frombuffer(buffer, dtype=dtype, count=count, offset=offset)
+    return array(host)  # noqa: F821
+
+
+def real_if_close(a, tol=100):
+    data = a.data if isinstance(a, NDArray) else jnp.asarray(a)
+    if not jnp.iscomplexobj(data):
+        # numpy returns the input unchanged — preserves tape lineage
+        return a if isinstance(a, NDArray) else _wrap(data)
+    eps = _onp.finfo(_onp.asarray(data.real).dtype).eps
+    # jnp.all is True on empty arrays, matching numpy's behavior
+    if bool(jnp.all(jnp.abs(data.imag) < tol * eps)):
+        return _call_recorded(jnp.real, "real_if_close", (a,), {})
+    return a if isinstance(a, NDArray) else _wrap(data)
+
+
+def _root(x):
+    """Follow the slice-view chain to the owning NDArray (views write
+    through to their base in this framework — see ndarray.py)."""
+    while isinstance(x, NDArray) and x._base is not None:
+        x = x._base
+    return x
+
+
+def shares_memory(a, b, max_work=None):
+    """True when the two handles alias the same storage: the same root
+    array (covers write-through slice views) or the same jax buffer."""
+    ra, rb = _root(a), _root(b)
+    if isinstance(ra, NDArray) and isinstance(rb, NDArray):
+        if ra is rb:
+            return True
+    da = ra.data if isinstance(ra, NDArray) else ra
+    db = rb.data if isinstance(rb, NDArray) else rb
+    return da is db
+
+
+def may_share_memory(a, b, max_work=None):
+    return shares_memory(a, b)
 
 
 def msort(a):

@@ -332,3 +332,61 @@ def test_npx_save_load_waitall_use_np(tmp_path):
     # namespace hygiene: no camelCase or loop-variable leaks
     assert not hasattr(npx, "batchNorm") and not hasattr(npx, "low")
     assert callable(npx.batch_norm) and callable(npx.use_np)
+
+
+def test_np_round3_stragglers():
+    """geomspace/block/in1d/row_stack/fromiter/frombuffer/shares_memory/
+    apply_along_axis/fromfunction/setxor1d/einsum_path (reference: the
+    mx.np surface mirrors numpy's main namespace)."""
+    assert onp.allclose(np.geomspace(1, 1000, 4).asnumpy(),
+                        [1, 10, 100, 1000])
+    b = np.block([[np.ones((2, 2)), np.zeros((2, 2))]])
+    assert b.shape == (2, 4) and b.asnumpy()[0, 3] == 0
+    assert np.in1d(np.array([1, 2, 5]),
+                   np.array([2, 5])).asnumpy().tolist() == [False, True, True]
+    assert np.row_stack([np.ones(3), np.zeros(3)]).shape == (2, 3)
+    assert np.fromiter(range(5), dtype="int32").asnumpy().tolist() == \
+        [0, 1, 2, 3, 4]
+    assert np.frombuffer(b"\x00\x00\x80?",
+                         dtype="float32").asnumpy()[0] == 1.0
+    a = np.array([1.0, 2.0])
+    assert np.shares_memory(a, a)
+    assert not np.may_share_memory(a, np.array([1.0]))
+    # write-through slice views share memory with their base
+    base = np.array(onp.arange(6.0))
+    view = base[1:4]
+    assert np.shares_memory(base, view) and np.may_share_memory(view, base)
+    # einsum_path is metadata-only: safe on TRACKED arrays inside record
+    from mxnet_tpu import autograd as _ag
+
+    t = np.ones((2, 3))
+    t.attach_grad()
+    with _ag.record():
+        assert np.einsum_path("ij,jk->ik", t, np.ones((3, 4))) is not None
+    # real_if_close preserves lineage on real input
+    y = np.ones((2, 2))
+    y.attach_grad()
+    with _ag.record():
+        zz = (np.real_if_close(y) * 2).sum()
+    zz.backward()
+    assert onp.allclose(y.grad.asnumpy(), 2.0)
+    assert np.real_if_close(
+        np.array(onp.array([], dtype="complex64"))).shape == (0,)
+    assert np.apply_along_axis(lambda x: x.sum(), 1,
+                               np.ones((3, 4))).shape == (3,)
+    assert np.fromfunction(lambda i, j: i + j,
+                           (2, 2)).asnumpy().tolist() == [[0, 1], [1, 2]]
+    assert np.setxor1d(np.array([1, 2, 3]),
+                       np.array([2, 3, 4])).asnumpy().tolist() == [1, 4]
+    assert np.einsum_path("ij,jk->ik", np.ones((2, 3)),
+                          np.ones((3, 4))) is not None
+    # autograd flows through the new wrappers like every other np fn
+    from mxnet_tpu import autograd
+
+    x = np.ones((2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = np.geomspace(1, 100, 3) * x
+        z = y.sum()
+    z.backward()
+    assert onp.allclose(x.grad.asnumpy(), [[1, 10, 100]] * 2)
