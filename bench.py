@@ -284,10 +284,10 @@ def bench_allreduce(backend):
         return (allreduce(v) * (1.0 / max(ndev, 1)) + i * jnp.float32(1e-30),
                 i + 1)
 
-    # long chains: at ~0.1-0.4 ms/iter the slope needs several hundred
-    # iterations or relay RTT jitter dominates (observed 147-600 GB/s
-    # scatter with (5, 40))
-    per_iter = chain_time_per_iter(ar_step, (x, counter), 20, 320)
+    # very long chains: at ~0.1 ms/iter the two-point slope needs a few
+    # hundred ms of spread or relay RTT jitter dominates (observed
+    # 147-887 GB/s scatter at shorter chains)
+    per_iter = chain_time_per_iter(ar_step, (x, counter), 100, 2100)
     moved = nbytes * (2 * (ndev - 1) / ndev if ndev > 1 else 1.0)
     _emit(f"allreduce_psum_{nbytes >> 20}MB_{ndev}dev_{backend}",
           moved / per_iter / (1 << 30), "GB/s", None,

@@ -174,35 +174,57 @@ def real_if_close(a, tol=100):
     if not jnp.iscomplexobj(data):
         # numpy returns the input unchanged — preserves tape lineage
         return a if isinstance(a, NDArray) else _wrap(data)
-    eps = _onp.finfo(_onp.asarray(data.real).dtype).eps
+    # numpy semantics: tol > 1 scales machine eps; tol <= 1 is absolute
+    if tol > 1:
+        tol = float(jnp.finfo(data.dtype).eps) * tol
     # jnp.all is True on empty arrays, matching numpy's behavior
-    if bool(jnp.all(jnp.abs(data.imag) < tol * eps)):
+    if bool(jnp.all(jnp.abs(data.imag) < tol)):
         return _call_recorded(jnp.real, "real_if_close", (a,), {})
     return a if isinstance(a, NDArray) else _wrap(data)
 
 
-def _root(x):
-    """Follow the slice-view chain to the owning NDArray (views write
-    through to their base in this framework — see ndarray.py)."""
+def _view_span(x):
+    """(root, index-or-None) for overlap checks."""
+    idx = None
     while isinstance(x, NDArray) and x._base is not None:
+        idx = x._index if idx is None else idx  # outermost view's index
         x = x._base
-    return x
+    return x, idx
 
 
 def shares_memory(a, b, max_work=None):
-    """True when the two handles alias the same storage: the same root
-    array (covers write-through slice views) or the same jax buffer."""
-    ra, rb = _root(a), _root(b)
-    if isinstance(ra, NDArray) and isinstance(rb, NDArray):
-        if ra is rb:
-            return True
-    da = ra.data if isinstance(ra, NDArray) else ra
-    db = rb.data if isinstance(rb, NDArray) else rb
-    return da is db
+    """True when the two handles alias the same storage. Same root
+    (write-through views) counts as sharing unless both are sibling
+    slice views with PROVABLY disjoint leading-axis spans — numpy's
+    exact variant returns False for non-overlapping siblings."""
+    ra, ia = _view_span(a)
+    rb, ib = _view_span(b)
+    same_root = (ra is rb) if isinstance(ra, NDArray) else False
+    if not same_root:
+        da = ra.data if isinstance(ra, NDArray) else ra
+        db = rb.data if isinstance(rb, NDArray) else rb
+        return da is db
+    if ia is None or ib is None:
+        return True  # one side IS the base
+    sa = ia[0] if isinstance(ia, tuple) else ia
+    sb = ib[0] if isinstance(ib, tuple) else ib
+    if isinstance(sa, slice) and isinstance(sb, slice)             and (sa.step in (None, 1)) and (sb.step in (None, 1)):
+        dim = ra.shape[0]
+        a0, a1 = sa.indices(dim)[:2]
+        b0, b1 = sb.indices(dim)[:2]
+        return not (a1 <= b0 or b1 <= a0)
+    return True  # can't prove disjoint -> conservative
 
 
 def may_share_memory(a, b, max_work=None):
-    return shares_memory(a, b)
+    """Conservative variant: any same-root pair may share."""
+    ra, _ = _view_span(a)
+    rb, _ = _view_span(b)
+    if isinstance(ra, NDArray) and ra is rb:
+        return True
+    da = ra.data if isinstance(ra, NDArray) else ra
+    db = rb.data if isinstance(rb, NDArray) else rb
+    return da is db
 
 
 def msort(a):
