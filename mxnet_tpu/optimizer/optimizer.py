@@ -544,5 +544,104 @@ class Updater:
         self.states = pickle.loads(states)
 
 
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with one accumulator PER ROW (reference:
+    ``contrib/optimizer.py`` ``GroupAdaGrad`` over
+    ``_contrib_group_adagrad_update`` — the sparse-embedding optimizer:
+    a row's whole history updates together, which keeps row_sparse
+    gradients cheap). Weight decay is unsupported, as in the reference
+    (which asserts wd == 0)."""
+
+    def __init__(self, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        if weight.data.ndim < 1:
+            raise ValueError("GroupAdaGrad needs >= 1-dim weights")
+        return NDArray(jnp.zeros((weight.shape[0],), jnp.float32),
+                       ctx=weight.ctx)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        if self._get_wd(index) != 0.0:
+            raise MXNetError("GroupAdaGrad does not support weight decay "
+                             "(reference contract: wd must be 0)")
+        g = self._preprocess(grad)
+        reduce_axes = tuple(range(1, g.ndim))
+        hist = state.data + jnp.mean(jnp.square(g), axis=reduce_axes)             if g.ndim > 1 else state.data + jnp.square(g)
+        state._set_data(hist)
+        # reference kernel: div = sqrt(hist + eps), NOT sqrt(hist) + eps
+        div = jnp.sqrt(hist + self.float_stable_eps)
+        shape = (-1,) + (1,) * (g.ndim - 1)
+        w = weight.data
+        weight._set_data(
+            (w - lr * g / div.reshape(shape).astype(g.dtype)).astype(w.dtype))
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-Batch SGD with layer-wise adaptive rate scaling (reference:
+    ``optimizer.py`` ``LBSGD`` — LARS-style trust ratio + warmup for
+    large-batch training)."""
+
+    def __init__(self, momentum=0.0, warmup_strategy="linear",
+                 warmup_epochs=5, batch_scale=1, updates_per_epoch=32,
+                 begin_epoch=0, num_epochs=60, **kwargs):
+        # multi_precision rides **kwargs into Optimizer.__init__ so the
+        # fp32-master-weight machinery engages like every other optimizer
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = max(batch_scale, 1)
+        self.updates_per_epoch = max(updates_per_epoch, 1)
+        self.init_updates = begin_epoch * self.updates_per_epoch
+        self.num_epochs = num_epochs
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, jnp.float32), ctx=weight.ctx)
+
+    def _warmup_scale(self, nup):
+        """Ramp the lr multiplier from 1 to ``batch_scale`` over the
+        warmup (the point of large-batch SGD: linear-scaled lr reached
+        gradually), then hold at batch_scale."""
+        total_warm = self.warmup_epochs * self.updates_per_epoch
+        if total_warm <= 0 or nup >= total_warm:
+            return float(self.batch_scale)
+        frac = nup / total_warm
+        if self.warmup_strategy == "power2":
+            frac = frac ** 2
+        elif self.warmup_strategy == "sqrt":
+            frac = frac ** 0.5
+        return 1.0 + (self.batch_scale - 1.0) * frac if self.batch_scale > 1             else max(frac, 1.0 / total_warm)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        nup = self._index_update_count.get(index, 1) + self.init_updates
+        g = self._preprocess(grad).astype(jnp.float32)
+        w32 = weight.data.astype(jnp.float32)
+        # LARS trust ratio, fully on device (no host syncs in the step)
+        wnorm = jnp.linalg.norm(w32)
+        gnorm = jnp.linalg.norm(g)
+        lars = jnp.where((wnorm > 0) & (gnorm > 0),
+                         jnp.minimum(wnorm / (gnorm + wd * wnorm + 1e-9),
+                                     2.0),  # reference clips the ratio
+                         1.0)
+        eff_lr = lr * self._warmup_scale(nup) * lars
+        g = g + wd * w32
+        if self.momentum and state is not None:
+            m = self.momentum * state.data - eff_lr * g
+            state._set_data(m)
+            weight._set_data((w32 + m).astype(weight.data.dtype))
+        else:
+            weight._set_data((w32 - eff_lr * g).astype(weight.data.dtype))
+
+
 def get_updater(optimizer):
     return Updater(optimizer)

@@ -130,3 +130,87 @@ def test_lr_wd_mult():
     opt.set_lr_mult({0: 0.1})
     assert opt._get_lr(0) == pytest.approx(0.1)
     assert opt._get_lr(1) == pytest.approx(1.0)
+
+
+def test_group_adagrad_row_wise_history():
+    """GroupAdaGrad (reference: contrib GroupAdaGrad over
+    _contrib_group_adagrad_update): ONE accumulator per row, so every
+    element of a row shares its effective lr."""
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("groupadagrad", learning_rate=0.1)
+    with pytest.raises(mx.base.MXNetError):
+        bad = opt.create("groupadagrad", learning_rate=0.1, wd=1e-4)
+        bad.update(9, mx.nd.ones((2, 2)), mx.nd.ones((2, 2)),
+                   bad.create_state(9, mx.nd.ones((2, 2))))
+    w = mx.nd.ones((3, 4))
+    g = mx.nd.array(np.array([[1, 1, 1, 1],
+                              [2, 2, 2, 2],
+                              [0, 0, 0, 0]], np.float32))
+    state = o.create_state(0, w)
+    assert state.shape == (3,)
+    o.update(0, w, g, state)
+    wn = w.asnumpy()
+    # within a row, updates are identical; zero-grad row unchanged
+    for r in range(3):
+        assert np.allclose(wn[r], wn[r][0])
+    assert np.allclose(wn[2], 1.0)
+    assert wn[0][0] != wn[1][0]
+
+
+def test_lbsgd_warmup_and_trust_ratio():
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.create("lbsgd", learning_rate=1.0, momentum=0.0,
+                   warmup_strategy="linear", warmup_epochs=1,
+                   updates_per_epoch=10)
+    w = mx.nd.ones((4,))
+    g = mx.nd.full((4,), 0.5)
+    w0 = w.asnumpy().copy()
+    o.update(0, w, g, o.create_state(0, w))
+    d1 = np.abs(w.asnumpy() - w0).max()
+    # early-warmup step is scaled down hard
+    assert 0 < d1 < 0.5
+    # batch_scale ramps the post-warmup lr multiplier
+    ob = opt.create("lbsgd", learning_rate=0.01, warmup_epochs=0,
+                    batch_scale=8)
+    wb = mx.nd.ones((4,))
+    ob.update(2, wb, mx.nd.full((4,), 0.5), ob.create_state(2, wb))
+    small = opt.create("lbsgd", learning_rate=0.01, warmup_epochs=0,
+                       batch_scale=1)
+    ws = mx.nd.ones((4,))
+    small.update(3, ws, mx.nd.full((4,), 0.5), small.create_state(3, ws))
+    assert np.abs(wb.asnumpy() - 1).max() > np.abs(ws.asnumpy() - 1).max()
+    # fp16 weights keep their dtype through the update
+    wh = mx.nd.ones((4,)).astype("float16")
+    o.update(4, wh, mx.nd.full((4,), 0.5).astype("float16"),
+             o.create_state(4, wh))
+    assert wh.dtype == np.float16
+    # trust ratio caps at 2: with tiny grads the step never explodes
+    w2 = mx.nd.ones((4,))
+    o2 = opt.create("lbsgd", learning_rate=1.0, warmup_epochs=0)
+    o2.update(1, w2, mx.nd.full((4,), 1e-8), o2.create_state(1, w2))
+    assert np.abs(w2.asnumpy() - 1.0).max() < 1.0
+
+
+def test_new_optimizers_converge():
+    from mxnet_tpu import autograd, gluon
+
+    for name in ("groupadagrad", "lbsgd"):
+        net = gluon.nn.Dense(4, in_units=6)
+        net.initialize()
+        kwargs = {"learning_rate": 0.1}
+        if name == "lbsgd":
+            kwargs["momentum"] = 0.9
+        tr = gluon.Trainer(net.collect_params(), name, kwargs)
+        loss_fn = gluon.loss.L2Loss()
+        x = mx.nd.random.uniform(shape=(8, 6))
+        y = mx.nd.ones((8, 4))
+        losses = []
+        for _ in range(15):
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            tr.step(1)
+            losses.append(float(loss.asnumpy()))
+        assert losses[-1] < losses[0], (name, losses)
