@@ -72,7 +72,15 @@ class LossScaler:
         import numpy as np
 
         for p in params:
-            g = p.grad() if hasattr(p, "grad") else p
+            # accepts Parameters (grad() method) and raw arrays (whose
+            # .grad ATTRIBUTE is None unless autograd attached one)
+            grad_attr = getattr(p, "grad", None)
+            if callable(grad_attr):
+                g = grad_attr()          # Parameter.grad() method
+            elif grad_attr is not None:
+                g = grad_attr            # raw array with an attached grad
+            else:
+                g = p                    # plain array: inspect its values
             if g is None:
                 continue
             a = g.asnumpy()

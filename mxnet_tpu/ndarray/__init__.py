@@ -28,8 +28,12 @@ from .op import *  # noqa: F401,F403
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from . import image  # noqa: F401
-from .sparse import cast_storage  # noqa: F401  (reference: top-level nd.cast_storage)
 from . import contrib  # noqa: F401
+# hybrid_forward's F namespace is the op module; reference code writes
+# F.contrib.* there, so expose the contrib namespace on it
+op.contrib = contrib
+op.image = image
+from .sparse import cast_storage  # noqa: F401  (reference: top-level nd.cast_storage)
 
 
 def Custom(*inputs, op_type=None, **kwargs):
