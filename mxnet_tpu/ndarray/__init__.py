@@ -30,7 +30,8 @@ from . import sparse  # noqa: F401
 from . import image  # noqa: F401
 from . import contrib  # noqa: F401
 # hybrid_forward's F namespace is the op module; reference code writes
-# F.contrib.* there, so expose the contrib namespace on it
+# F.contrib.* there, so expose the contrib namespace on it (the symbol
+# F namespace gets the same seam in symbol/__init__.py)
 op.contrib = contrib
 op.image = image
 from .sparse import cast_storage  # noqa: F401  (reference: top-level nd.cast_storage)

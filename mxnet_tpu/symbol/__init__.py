@@ -29,3 +29,29 @@ def zeros(shape, dtype="float32", **kw):  # symbolic zeros becomes a constant va
     from .symbol import Symbol
 
     return Symbol("_zeros_const", {"shape": tuple(shape), "dtype": dtype}, [])
+
+# hybrid_forward's SYMBOLIC F namespace (export/SymbolBlock path) mirrors
+# the nd one: F.contrib.* and F.image.* resolve to the sym op namespace
+# (flat names like F.contrib.cond fall back to the registered sym ops)
+from . import op as _op_ns  # noqa: E402
+
+
+class _SymSubNamespace:
+    """Attribute proxy: F.contrib.X / F.image.X -> the sym op for X
+    (contrib control-flow gets the real symbolic implementations when
+    they exist as registered ops; everything else resolves by name)."""
+
+    def __init__(self, prefixes):
+        self._prefixes = prefixes
+
+    def __getattr__(self, name):
+        for pre in self._prefixes:
+            if hasattr(_op_ns, pre + name):
+                return getattr(_op_ns, pre + name)
+        if hasattr(_op_ns, name):
+            return getattr(_op_ns, name)
+        raise AttributeError(name)
+
+
+_op_ns.contrib = _SymSubNamespace(("_contrib_",))
+_op_ns.image = _SymSubNamespace(("_image_", "image_"))

@@ -312,8 +312,10 @@ def test_amp_scale_loss_trains_fp16_safely():
         if scaled:
             old = amp._STATE["target_dtype"]
             amp._STATE["target_dtype"] = "float16"  # engage the scaler
-            amp.init_trainer(tr)
-            amp._STATE["target_dtype"] = old
+            try:
+                amp.init_trainer(tr)
+            finally:
+                amp._STATE["target_dtype"] = old
         for _ in range(3):
             with autograd.record():
                 loss = loss_fn(net(x), y).mean()
@@ -364,3 +366,30 @@ def test_control_flow_cond_eager_and_hybrid():
     neg = mx.nd.full((2,), -1.0)
     np.testing.assert_allclose(first(net(pos)).asnumpy(), [2.0, 2.0])
     np.testing.assert_allclose(first(net(neg)).asnumpy(), [1.0, 1.0])
+
+
+def test_f_contrib_symbolic_export_roundtrip(tmp_path):
+    """F.contrib.* must resolve on BOTH F namespaces: traced (nd op) and
+    symbolic (export/SymbolBlock.imports) — review regression."""
+    from mxnet_tpu import gluon
+
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = gluon.nn.Dense(4, in_units=4)
+
+        def hybrid_forward(self, F, x):
+            y = self.fc(x)
+            return y + F.contrib.arange_like(y, axis=1)
+
+    net = Net()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.ones((2, 4))
+    out1 = net(x)
+    prefix = str(tmp_path / "net")
+    net.export(prefix)
+    back = gluon.SymbolBlock.imports(prefix + "-symbol.json", ["data"],
+                                     prefix + "-0000.params")
+    np.testing.assert_allclose(out1.asnumpy(), back(x).asnumpy(), rtol=1e-5)
