@@ -102,7 +102,7 @@ class TapeNode:
     of already-recorded consumers."""
 
     __slots__ = ("vjp_fn", "inputs", "input_slots", "n_outputs",
-                 "out_arrays", "out_cts", "name", "_order")
+                 "out_arrays", "out_cts", "name", "_order", "_replay")
 
     def __init__(self, vjp_fn, inputs, n_outputs, name=""):
         self.vjp_fn = vjp_fn
@@ -112,6 +112,11 @@ class TapeNode:
         self.out_cts = None  # filled during backward
         self.name = name
         self._order = -1
+        # (fwd_closure, record-time tracked raw values): lets
+        # grad(create_graph=True) re-derive this op as a pure function of
+        # its tracked inputs. The raw values are the same objects the vjp
+        # closure already holds, so this costs no extra device memory.
+        self._replay = None
 
 
 def _node_of(arr):
@@ -268,20 +273,124 @@ def backward(heads, head_grads=None, retain_graph: bool = False, train_mode: boo
                 o._ag = None
 
 
+def _grad_create_graph(heads, variables, head_grads):
+    """Higher-order ``grad``: replay the tape as a pure function of the
+    variables, ``jax.vjp`` it, and record the whole gradient computation
+    as ONE new tape node — so the returned grads are themselves
+    differentiable (2nd, 3rd, ... order compose recursively because the
+    grad node gets its own replay closure via ``record_functional``).
+
+    Reference: ``Imperative::Backward`` ``create_graph`` path +
+    ``tests/python/unittest/test_higher_order_grad.py``.
+    """
+    if not is_recording():
+        raise MXNetError(
+            "create_graph=True must be called inside autograd.record(): the "
+            "returned gradients are recorded on the tape")
+    roots = [h._ag[0] for h in heads if getattr(h, "_ag", None) is not None]
+    order = _toposort(roots)
+    for node in order:
+        if node._replay is None:
+            raise MXNetError(
+                f"create_graph=True cannot differentiate through node "
+                f"'{node.name}': it has no replayable forward (custom "
+                "autograd.Function backwards are opaque to higher-order "
+                "grad)")
+        saved = node._replay[1]
+        for inp, slot, sv in zip(node.inputs, node.input_slots, saved):
+            # Two mutation signatures: lineage rebound (snapshot_lineage
+            # path), or the raw buffer swapped under the same lineage
+            # (_iop / _set_data path). Either way the live handle no
+            # longer denotes the record-time value, so identity-based
+            # variable substitution would linearize at the wrong point.
+            if (getattr(inp, "_ag", None) is not slot
+                    or inp._data_ is not sv):
+                raise MXNetError(
+                    f"create_graph=True on a tape where an input of "
+                    f"'{node.name}' was mutated in place (or the tape was "
+                    "already consumed by a backward without retain_graph) "
+                    "is not supported")
+    for v in variables:
+        if not is_tracked(v):
+            raise MXNetError(
+                "create_graph=True requires every variable to be tracked "
+                "(attach_grad() before recording, or be on the tape)")
+
+    var_ids = [id(v) for v in variables]
+    head_info = []  # per head: ("var", idx) | ("node", node, k) | ("const", raw)
+    for h in heads:
+        if id(h) in var_ids:
+            head_info.append(("var", var_ids.index(id(h))))
+        elif getattr(h, "_ag", None) is not None:
+            head_info.append(("node", h._ag[0], h._ag[1]))
+        elif is_tracked(h):
+            head_info.append(("const", h.data))  # tracked leaf head
+        else:
+            raise MXNetError(
+                "cannot differentiate a head that is not on the tape; "
+                "run inside autograd.record() and/or attach_grad()")
+    seeds = tuple(
+        hg.data if hg is not None else jnp.ones(h.shape, h.data.dtype)
+        for h, hg in zip(heads, head_grads))
+
+    def _forward(*var_raws):
+        var_map = dict(zip(var_ids, var_raws))
+        env = {}
+        for node in order:
+            fwd, saved = node._replay
+            tvals = []
+            for inp, slot, sv in zip(node.inputs, node.input_slots, saved):
+                # A variable input wins (cut semantics: grad w.r.t. an
+                # intermediate treats it as independent). Safe against the
+                # handle-rebinding hazard in TapeNode's docstring because
+                # the mutation guard above rejects any tape where a live
+                # handle's lineage differs from its record-time slot.
+                if id(inp) in var_map:
+                    tvals.append(var_map[id(inp)])
+                elif slot is not None and id(slot[0]) in env:
+                    tvals.append(env[id(slot[0])][slot[1]])
+                else:
+                    tvals.append(sv)  # record-time leaf value
+            res = fwd(*tvals)
+            env[id(node)] = list(res) if isinstance(res, (list, tuple)) \
+                else [res]
+        outs = []
+        for kind, *rest in head_info:
+            if kind == "var":
+                outs.append(var_map[var_ids[rest[0]]])
+            elif kind == "node":
+                outs.append(env[id(rest[0])][rest[1]])
+            else:
+                outs.append(rest[0])
+        return tuple(outs)
+
+    def gradfn(*var_raws):
+        _, vjp_fn = jax.vjp(_forward, *var_raws)
+        gs = vjp_fn(seeds)
+        return gs if len(gs) > 1 else gs[0]
+
+    result = record_functional(gradfn, tuple(variables), {},
+                               "grad(create_graph)")
+    return list(result) if isinstance(result, (list, tuple)) else [result]
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
-    """Reference: ``autograd.py:grad`` — return grads w.r.t. ``variables``.
-
-    ``create_graph`` (higher-order tape) is not yet supported; use
-    ``jax.grad`` composition via hybridized blocks for higher-order needs.
-    """
+    """Reference: ``autograd.py:grad`` — return grads w.r.t. ``variables``."""
     from .ndarray.ndarray import NDArray, array as _mk
 
-    if create_graph:
-        raise NotImplementedError("create_graph=True not supported yet")
     single = isinstance(variables, NDArray)
     if single:
         variables = [variables]
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+    if create_graph:
+        out = _grad_create_graph(heads, variables, head_grads)
+        return out[0] if single else out
     saved = [(v._grad, getattr(v, "_grad_req", "write")) for v in variables]
     for v in variables:
         v._grad = _mk(jnp.zeros(v.shape, v.data.dtype), ctx=v.ctx)
@@ -390,11 +499,13 @@ def record_functional(jfn, args, kwargs, name, wrap=None):
             full[i] = v
         return rebuild(full)
 
-    res, vjp_fn = jax.vjp(g, *[leaves[i].data for i in tracked])
+    tracked_raw = [leaves[i].data for i in tracked]
+    res, vjp_fn = jax.vjp(g, *tracked_raw)
     result = wrap(res)
     outs = list(result) if isinstance(result, (list, tuple)) else [result]
     node = TapeNode(vjp_fn, [leaves[i] for i in tracked], len(outs),
                     name=name)
+    node._replay = (g, tracked_raw)  # for grad(create_graph=True)
     node.out_arrays = list(outs)
     for k, o in enumerate(outs):
         if isinstance(o, NDArray):

@@ -590,6 +590,19 @@ class _CachedGraph:
 
             node = autograd.TapeNode(node_vjp, tape_inputs, len(outs),
                                      name=f"CachedOp[{block_name(block)}]")
+
+            def replay_fwd(*tvals):
+                # pure forward as a function of the tracked inputs, for
+                # grad(create_graph=True): diff params first, then input
+                # arrays when tracked (matches tape_inputs order)
+                dp2 = list(tvals[:len(diff_param_pos)])
+                ir2 = list(tvals[len(diff_param_pos):]) if inputs_tracked \
+                    else input_raws
+                o, _m, _s = pure_fn(assemble(dp2, ndp), ir2, key)
+                return o
+
+            node._replay = (replay_fwd,
+                            dp + (input_raws if inputs_tracked else []))
             node.out_arrays = outs
             for k, o in enumerate(outs):
                 o._ag = (node, k)

@@ -114,6 +114,7 @@ def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
     node = autograd.TapeNode(
         vjp_fn, [args[i] for i in tracked_idx], len(outs), name=opdef.name
     )
+    node._replay = (f, tracked_raw)  # for grad(create_graph=True)
     node.out_arrays = list(outs)
     for k, o in enumerate(outs):
         o._ag = (node, k)

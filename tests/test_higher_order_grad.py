@@ -1,0 +1,206 @@
+"""Higher-order autograd (reference model: test_higher_order_grad.py).
+
+Exercises ``mx.autograd.grad(..., create_graph=True)``: the tape-replay
+path records the gradient computation as a new tape node, so 2nd and 3rd
+derivatives compose (reference: ``Imperative::Backward`` create_graph).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _check_second_order_unary(x_np, fwd, expect_grad_grad):
+    """Reference pattern: grad-of-grad of an elementwise op via
+    create_graph=True then .backward() on the first-order grad."""
+    x = mx.nd.array(x_np)
+    x.attach_grad()
+    with autograd.record():
+        y = fwd(x)
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+    gx.backward()
+    assert_almost_equal(x.grad, expect_grad_grad(x_np), rtol=1e-5, atol=1e-6)
+
+
+def test_sin_second_order():
+    x = np.random.uniform(-2, 2, (3, 4)).astype(np.float32)
+    _check_second_order_unary(x, mx.nd.sin, lambda v: -np.sin(v))
+
+
+def test_cos_second_order():
+    x = np.random.uniform(-2, 2, (5,)).astype(np.float32)
+    _check_second_order_unary(x, mx.nd.cos, lambda v: -np.cos(v))
+
+
+def test_exp_second_order():
+    x = np.random.uniform(-1, 1, (4,)).astype(np.float32)
+    _check_second_order_unary(x, mx.nd.exp, np.exp)
+
+
+def test_log_second_order():
+    x = np.random.uniform(0.5, 3, (6,)).astype(np.float32)
+    _check_second_order_unary(x, mx.nd.log, lambda v: -1.0 / v ** 2)
+
+
+def test_sigmoid_second_order():
+    x = np.random.uniform(-2, 2, (4,)).astype(np.float32)
+
+    def expect(v):
+        s = 1 / (1 + np.exp(-v))
+        return s * (1 - s) * (1 - 2 * s)
+
+    _check_second_order_unary(x, mx.nd.sigmoid, expect)
+
+
+def test_relu_second_order():
+    x = np.random.uniform(-2, 2, (8,)).astype(np.float32)
+    _check_second_order_unary(x, mx.nd.relu, lambda v: np.zeros_like(v))
+
+
+def test_polynomial_third_order():
+    v = np.array([0.5, 1.5, -2.0], np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 4
+        g1 = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        g2 = autograd.grad(g1, x, create_graph=True, retain_graph=True)
+    g2.backward()
+    assert_almost_equal(x.grad, 24 * v, rtol=1e-5)
+
+
+def test_two_variables_second_order():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 2).astype(np.float32)
+    a, b = mx.nd.array(a_np), mx.nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        z = (mx.nd.dot(a, b) ** 2).sum()
+        ga, gb = autograd.grad(z, [a, b], create_graph=True,
+                               retain_graph=True)
+        s = (ga * ga).sum()
+    s.backward()
+    # z = sum(M^2), M = a@b; ga = 2*M@b.T; s = sum(ga^2)
+    # ds/da = 2*ga * d(ga)/da contracted: d(ga)/da = 2*(I kron b)@b.T ...
+    # verify against a JAX reference instead of hand algebra
+    import jax
+    import jax.numpy as jnp
+
+    def s_of_a(ar):
+        ga_ = jax.grad(lambda aa: jnp.sum((aa @ b_np) ** 2))(ar)
+        return jnp.sum(ga_ ** 2)
+
+    expect = jax.grad(s_of_a)(a_np)
+    assert_almost_equal(a.grad, np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+
+def test_grad_grad_with_head_grads():
+    v = np.array([1.0, 2.0], np.float32)
+    x = mx.nd.array(v)
+    x.attach_grad()
+    w = mx.nd.array(np.array([3.0, 5.0], np.float32))
+    with autograd.record():
+        y = x ** 3
+        gx = autograd.grad(y, x, head_grads=w, create_graph=True,
+                           retain_graph=True)
+    gx.backward()
+    # gx = w * 3x^2; d(gx)/dx = w * 6x
+    assert_almost_equal(x.grad, np.array([3.0, 5.0]) * 6 * v, rtol=1e-5)
+
+
+def test_create_graph_through_block():
+    """Second order through a small Gluon net (dense + activation)."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.tanh(net(x)).sum()
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        s = (gx * gx).sum()
+    s.backward()
+    assert x.grad.shape == x.shape
+    assert np.isfinite(x.grad.asnumpy()).all()
+
+
+def test_create_graph_through_hybridized_block():
+    """Second order through a hybridized block (CachedOp replay)."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.rand(2, 3).astype(np.float32))
+    x.attach_grad()
+    net(x)  # build the cache
+    with autograd.record():
+        y = mx.nd.tanh(net(x)).sum()
+        gx = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        s = (gx * gx).sum()
+    s.backward()
+    # cross-check against the non-hybridized second-order result
+    net2 = gluon.nn.Dense(4, in_units=3)
+    net2.initialize()
+    for (k1, p1), (k2, p2) in zip(sorted(net.collect_params().items()),
+                                  sorted(net2.collect_params().items())):
+        p2.set_data(p1.data())
+    x2 = mx.nd.array(x.asnumpy())
+    x2.attach_grad()
+    with autograd.record():
+        y2 = mx.nd.tanh(net2(x2)).sum()
+        gx2 = autograd.grad(y2, x2, create_graph=True, retain_graph=True)
+        s2 = (gx2 * gx2).sum()
+    s2.backward()
+    assert_almost_equal(x.grad, x2.grad.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_create_graph_outside_record_raises():
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x ** 2
+    with pytest.raises(mx.base.MXNetError):
+        autograd.grad(y, x, create_graph=True, retain_graph=True)
+
+
+def test_create_graph_after_mutation_raises():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        x += 1
+        z = y + x
+        with pytest.raises(mx.base.MXNetError):
+            autograd.grad(z, x, create_graph=True, retain_graph=True)
+
+
+def test_create_graph_requires_tracked():
+    x = mx.nd.array([1.0])
+    x.attach_grad()
+    z = mx.nd.array([2.0])  # never tracked
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.base.MXNetError):
+            autograd.grad(y, z, create_graph=True, retain_graph=True)
+
+
+def test_custom_function_raises():
+    class Square(autograd.Function):
+        def forward(self, x):
+            return x * x
+
+        def backward(self, dy):
+            return 2 * dy
+
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = Square()(x)
+        with pytest.raises(mx.base.MXNetError):
+            autograd.grad(y, x, create_graph=True, retain_graph=True)
