@@ -129,14 +129,22 @@ def check_numeric_gradient(fn, inputs, grads=None, eps=1e-4, rtol=1e-2,
     (reference: ``check_numeric_gradient`` — the workhorse of
     test_operator.py)."""
     arrays = [a if isinstance(a, NDArray) else _array(a) for a in inputs]
-    for a in arrays:
-        a.attach_grad()
+    # non-float inputs (indices, boolean masks) are constants: no gradient
+    # is defined and central differences would corrupt them
+    is_float = [_np.issubdtype(_np.dtype(str(a.dtype)), _np.floating)
+                for a in arrays]
+    for a, fl in zip(arrays, is_float):
+        if fl:
+            a.attach_grad()
     with autograd.record():
         out = fn(*arrays)
     out.backward()
-    analytic = [a.grad.asnumpy() for a in arrays]
+    analytic = [a.grad.asnumpy() if fl else None
+                for a, fl in zip(arrays, is_float)]
 
     for idx, a in enumerate(arrays):
+        if not is_float[idx]:
+            continue
         base = a.asnumpy().astype(_np.float64)
         num = _np.zeros_like(base)
         flat = base.reshape(-1)
