@@ -265,8 +265,16 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     shape[axis] = data.shape[axis]
     if training and not use_global_stats:
         red = tuple(i for i in range(data.ndim) if i != axis)
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # one-pass stats in f32: E[x] and E[x^2] fuse into a single read
+        # of the conv output, where jnp.var's two-pass form re-reads it
+        # (measured on v5e: -5ms/step on ResNet-50 bs128, +12% img/s —
+        # tools/probe_resnet_layout.py). Trade-off: E[x^2]-E[x]^2 can
+        # cancel catastrophically when |mean| >> std (un-normalized
+        # inputs); the clamp below floors it at 0. Same form and
+        # rationale as flax.linen.BatchNorm on TPU.
+        xf = data.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.maximum(jnp.mean(xf * xf, axis=red) - mean * mean, 0.0)
         new_mean = momentum * moving_mean + (1 - momentum) * mean
         new_var = momentum * moving_var + (1 - momentum) * var
     else:

@@ -72,7 +72,7 @@ def conv(x, w, stride, pad, layout):
         dimension_numbers=lax.conv_dimension_numbers(x.shape, w.shape, dn))
 
 
-BN_MODE = "fp32"  # fp32 | bf16 | none
+BN_MODE = "fp32"  # fp32 | bf16 | 1pass | none
 
 
 def bn_relu(x, p, layout, relu=True):
@@ -83,9 +83,15 @@ def bn_relu(x, p, layout, relu=True):
         out = x + p["beta"].reshape(shape)
         return jnp.maximum(out, 0) if relu else out
     red = tuple(i for i in range(4) if i != (ax % 4))
-    xf = x.astype(jnp.float32) if BN_MODE == "fp32" else x
-    mean = jnp.mean(xf, axis=red)
-    var = jnp.var(xf, axis=red)
+    xf = x.astype(jnp.float32) if BN_MODE in ("fp32", "1pass") else x
+    if BN_MODE == "1pass":
+        # one fused read: both reductions share the same pass over x
+        mean = jnp.mean(xf, axis=red)
+        ex2 = jnp.mean(xf * xf, axis=red)
+        var = jnp.maximum(ex2 - mean * mean, 0.0)
+    else:
+        mean = jnp.mean(xf, axis=red)
+        var = jnp.var(xf, axis=red)
     inv = lax.rsqrt(var + 1e-5).astype(x.dtype)
     out = (x - mean.astype(x.dtype).reshape(shape)) * inv.reshape(shape) \
         * p["gamma"].reshape(shape) + p["beta"].reshape(shape)
@@ -176,7 +182,6 @@ if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
     BN_MODE = sys.argv[3] if len(sys.argv) > 3 else "fp32"
-    globals()["BN_MODE"] = BN_MODE
     print(f"bn_mode={BN_MODE}")
     if which in ("nchw", "both"):
         probe("NCHW", batch)
