@@ -9,6 +9,7 @@ from .io import (  # noqa: F401
     PrefetchingIter,
     MXDataIter,
     CSVIter,
+    LibSVMIter,
     ImageRecordIter,
     MNISTIter,
 )

@@ -362,6 +362,139 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
+def _parse_libsvm(path, dtype):
+    """Parse a zero-based-index LibSVM file into CSR triplets + labels.
+
+    Reference: ``src/io/iter_libsvm.cc`` (``LibSVMIterParam`` — indices
+    are zero-based; ``#`` starts a comment; one or more leading label
+    columns per row)."""
+    indptr = [0]
+    indices = []
+    values = []
+    labels = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            lab = []
+            feat_start = 0
+            for tok in toks:
+                if ":" in tok:
+                    break
+                lab.append(float(tok))
+                feat_start += 1
+            for tok in toks[feat_start:]:
+                i, v = tok.split(":", 1)
+                indices.append(int(i))
+                values.append(float(v))
+            indptr.append(len(indices))
+            labels.append(lab if lab else [0.0])
+    width = max(len(l) for l in labels) if labels else 1
+    lab_arr = _np.zeros((len(labels), width), dtype)
+    for r, l in enumerate(labels):
+        lab_arr[r, :len(l)] = l
+    return (_np.asarray(values, dtype), _np.asarray(indices, _np.int32),
+            _np.asarray(indptr, _np.int64), lab_arr)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM-format iterator yielding CSR data batches (reference:
+    ``src/io/iter_libsvm.cc`` registered via ``DataIteratorReg``).
+
+    ``data_libsvm``: path to the libsvm file; ``data_shape``: feature
+    dimension (int or 1-tuple). Labels come from the leading column(s)
+    of the data file, or — when ``label_libsvm`` is given — from the
+    feature vectors of that second libsvm file densified to
+    ``label_shape`` (the reference's multi-label arrangement).
+    ``round_batch=True`` wraps the last short batch to the epoch start;
+    ``False`` pads it with empty rows. Either way ``batch.pad`` reports
+    the non-original row count (reference ``num_batch_padd``)."""
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True,
+                 dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        from ..ndarray.sparse import CSRNDArray
+
+        self._csr_cls = CSRNDArray
+        if isinstance(data_shape, int):
+            data_shape = (data_shape,)
+        self._nfeat = int(data_shape[0])
+        vals, idx, indptr, file_labels = _parse_libsvm(data_libsvm, dtype)
+        if idx.size and int(idx.max()) >= self._nfeat:
+            raise MXNetError(
+                f"LibSVMIter: feature index {int(idx.max())} out of range "
+                f"for data_shape {self._nfeat} in {data_libsvm}")
+        self._vals, self._idx, self._indptr = vals, idx, indptr
+        self._nrows = len(indptr) - 1
+        if label_libsvm is not None:
+            lv, li, lp, _ = _parse_libsvm(label_libsvm, dtype)
+            if isinstance(label_shape, int):
+                label_shape = (label_shape,)
+            width = int(label_shape[0]) if label_shape else \
+                (int(li.max()) + 1 if li.size else 1)
+            if li.size and int(li.max()) >= width:
+                raise MXNetError(
+                    f"LibSVMIter: label index {int(li.max())} out of range "
+                    f"for label_shape {width} in {label_libsvm}")
+            dense = _np.zeros((len(lp) - 1, width), dtype)
+            for r in range(len(lp) - 1):
+                sl = slice(lp[r], lp[r + 1])
+                dense[r, li[sl]] = lv[sl]
+            self._labels = dense
+        else:
+            self._labels = file_labels
+        if len(self._labels) != self._nrows:
+            raise MXNetError(
+                f"LibSVMIter: {self._nrows} data rows but "
+                f"{len(self._labels)} label rows")
+        self._round_batch = round_batch
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size, self._nfeat))]
+        lab_shape = (batch_size,) if self._labels.shape[1] == 1 else \
+            (batch_size,) + self._labels.shape[1:]
+        self.provide_label = [DataDesc("softmax_label", lab_shape)]
+
+    def _rows(self, lo, hi):
+        sub_indptr = (self._indptr[lo:hi + 1] - self._indptr[lo]).astype(
+            _np.int64)
+        sl = slice(self._indptr[lo], self._indptr[hi])
+        return self._vals[sl], self._idx[sl], sub_indptr, self._labels[lo:hi]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= self._nrows:
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._nrows)
+        vals, idx, indptr, labels = self._rows(lo, hi)
+        pad = self.batch_size - (hi - lo)
+        if pad and self._round_batch and self._nrows >= self.batch_size:
+            # wrap to the epoch start; pad still REPORTS the wrapped row
+            # count (reference num_batch_padd) so consumers can exclude
+            # the duplicates from metrics
+            wvals, widx, windptr, wlabels = self._rows(0, pad)
+            vals = _np.concatenate([vals, wvals])
+            idx = _np.concatenate([idx, widx])
+            indptr = _np.concatenate([indptr, windptr[1:] + indptr[-1]])
+            labels = _np.concatenate([labels, wlabels])
+        elif pad:
+            # short tail: pad with empty rows
+            indptr = _np.concatenate(
+                [indptr, _np.full((pad,), indptr[-1], _np.int64)])
+            labels = _np.concatenate(
+                [labels, _np.zeros((pad,) + labels.shape[1:], labels.dtype)])
+        self._cursor = hi
+        data = self._csr_cls(vals, indptr, idx,
+                             (self.batch_size, self._nfeat))
+        label = _array(labels[:, 0] if labels.shape[1] == 1 else labels)
+        return DataBatch(data=[data], label=[label], pad=pad)
+
+
 class _NativeImageRecordIter(DataIter):
     """C++-backed RecordIO image pipeline (the reference's
     ``ImageRecordIter2`` role — decode/augment/batch off the Python thread)."""

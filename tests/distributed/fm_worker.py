@@ -26,7 +26,6 @@ import numpy as np
 import mxnet_tpu as mx
 from mxnet_tpu.kvstore.dist import init_distributed
 from mxnet_tpu.models import fm as fm_mod
-from mxnet_tpu.ndarray.sparse import csr_matrix
 
 init_distributed()
 rank = int(os.environ["MXTPU_PROCESS_ID"])
@@ -37,16 +36,28 @@ kv = mx.kv.create("dist_tpu_sync")
 F = 100
 fm = fm_mod.FactorizationMachine(F, num_factors=4, seed=1)
 # per-rank shard of the SAME planted model (seed fixes the planted
-# weights; sample draw differs by rank via the offset)
+# weights; sample draw differs by rank via the offset). The shard goes
+# through a .libsvm FILE and back via mx.io.LibSVMIter — the reference's
+# sparse on-disk on-ramp (src/io/iter_libsvm.cc), exercised end-to-end.
 vals, indptr, indices, labels = fm_mod.synthetic_ctr(
     120, F, seed=3)
 lo, hi = rank * (120 // nworkers), (rank + 1) * (120 // nworkers)
-row_slice = slice(lo, hi)
-sub_indptr = indptr[lo:hi + 1] - indptr[lo]
-sub_idx = indices[indptr[lo]:indptr[hi]]
-sub_vals = vals[indptr[lo]:indptr[hi]]
-X = csr_matrix((sub_vals, sub_idx, sub_indptr), shape=(hi - lo, F))
-y = mx.nd.array(labels[lo:hi])
+import tempfile
+
+shard_path = os.path.join(tempfile.gettempdir(),
+                          f"fm_shard_{os.getpid()}_{rank}.libsvm")
+with open(shard_path, "w") as f:
+    for r in range(lo, hi):
+        feats = " ".join(f"{indices[j]}:{vals[j]:g}"
+                         for j in range(indptr[r], indptr[r + 1]))
+        f.write(f"{labels[r]:g} {feats}\n")
+it = mx.io.LibSVMIter(data_libsvm=shard_path, data_shape=(F,),
+                      batch_size=hi - lo)
+batch = next(iter(it))
+assert batch.data[0].stype == "csr"
+X = batch.data[0]
+y = batch.label[0]
+os.unlink(shard_path)
 
 for name, p in fm.params().items():
     kv.init(name, p)
