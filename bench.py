@@ -295,7 +295,9 @@ def bench_allreduce(backend):
 
     # eager kvstore pushpull path (per-key kv.push/pull users hit);
     # iterations queue asynchronously so the relay round-trip amortizes
-    iters = 50
+    # (500 iters: at ~50us/call of Python the single ~100ms relay RTT
+    # would otherwise dominate and report latency, not the path's rate)
+    iters = 500
     kv = mx.kv.create("device")
     shape = (n_elem,)
     kv.init("w", mx.nd.zeros(shape))

@@ -46,14 +46,27 @@ def _use_pallas(d):
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
-                      kv_blocks, window=0, true_t=0):
+                      kv_blocks, window=0, true_t=0, n_active=0):
     """``true_t > 0`` = grouped-query mode: the q rows are G stacked
     heads of a TRUE sequence length ``true_t`` (the wrapper guarantees
     bq | true_t, so a block never straddles heads); masks use the row's
-    position WITHIN its head, ``global_row % true_t``."""
+    position WITHIN its head, ``global_row % true_t``.
+
+    ``n_active > 0`` = banded sliding-window mode: the kv grid dimension
+    covers only the ``n_active`` blocks that can intersect the band, and
+    the TRUE kv block index is derived from the q position — grid steps
+    (and their k/v DMA) scale as O(T*W) instead of O(T^2)."""
     ki = pl.program_id(2)
     qi = pl.program_id(1)
     q_pos0 = (qi * bq) % true_t if true_t else qi * bq
+    if n_active:
+        kv_blk = q_pos0 // bk - (n_active - 1) + ki
+        col0 = kv_blk * bk
+        last_ki = n_active - 1
+    else:
+        kv_blk = ki
+        col0 = ki * bk
+        last_ki = kv_blocks - 1
 
     @pl.when(ki == 0)
     def _init():
@@ -62,14 +75,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32) * scale         # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                 # (bk, d)
-        v = v_ref[0].astype(jnp.float32)                 # (bk, d)
+        # matmul operands stay in the INPUT dtype (bf16 on the training
+        # path) with f32 MXU accumulation: fp32xfp32 runs at ~1/4 the
+        # bf16 MXU rate on v5e — casting up first capped the whole kernel
+        # at ~51 TFLOP/s (measured; the fp32 matmul ceiling)
+        q = q_ref[0]                                     # (bq, d)
+        k = k_ref[0]                                     # (bk, d)
+        v = v_ref[0]                                     # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
+                                preferred_element_type=jnp.float32) * scale
         if causal or window > 0:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
             ok = rows >= cols
             if window > 0:  # sliding window: see only the last W positions
                 ok = ok & (rows - cols < window)
@@ -81,16 +98,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         alpha = jnp.exp(m_prev - m_new)                  # (bq, 1)
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
 
     if causal or window > 0:
         # skip blocks entirely above the diagonal, and (windowed) blocks
-        # entirely below the band
-        cond = ki * bk <= q_pos0 + bq - 1
+        # entirely below the band; banded mode additionally guards the
+        # clamped negative block indices at the sequence start
+        cond = col0 <= q_pos0 + bq - 1
         if window > 0:
-            cond = cond & (ki * bk + bk - 1 >= q_pos0 - window + 1)
+            cond = cond & (col0 + bk - 1 >= q_pos0 - window + 1)
+        if n_active:
+            cond = cond & (kv_blk >= 0)
 
         @pl.when(cond)
         def _():
@@ -98,7 +118,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     else:
         compute()
 
-    @pl.when(ki == kv_blocks - 1)
+    @pl.when(ki == last_ki)
     def _finish():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
@@ -137,17 +157,36 @@ def _pallas_flash_fwd(q, k, v, scale, causal, bq=512, bk=512, window=0):
     kr = k.reshape(B * KVH, S, D)
     vr = v.reshape(B * KVH, S, D)
     kv_blocks = S // bk
-    grid = (B * KVH, t_eff // bq, kv_blocks)
+    # banded grid for sliding-window: only the blocks that can intersect
+    # the band get grid steps (O(T*W) instead of O(T^2) DMA + overhead)
+    n_active = 0
+    # banded indexing assumes self-attention (t_eff == S): with T != S
+    # the clamped DMA index and the kernel's unclamped mask positions
+    # would disagree (the public op already enforces T == S for windows;
+    # this guard keeps internal callers safe too)
+    if window > 0 and bq == bk and true_t == 0 and t_eff == S:
+        n_active = min((window - 1) // bk + 2, kv_blocks)
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, kv_blocks=kv_blocks,
-                               window=window, true_t=true_t)
+                               window=window, true_t=true_t,
+                               n_active=n_active)
+    if n_active:
+        grid = (B * KVH, t_eff // bq, n_active)
+
+        def kv_map(b, i, j, _n=n_active, _max=kv_blocks - 1):
+            return (b, jnp.clip(i - (_n - 1) + j, 0, _max), 0)
+
+        kv_spec = pl.BlockSpec((1, bk, D), kv_map)
+    else:
+        grid = (B * KVH, t_eff // bq, kv_blocks)
+        kv_spec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -210,14 +249,15 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def compute():
-        q = q_ref[0].astype(jnp.float32)                 # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                 # (bk, d)
-        v = v_ref[0].astype(jnp.float32)                 # (bk, d)
-        do = do_ref[0].astype(jnp.float32)               # (bq, d)
+        # bf16 matmul operands + f32 accumulation (see _flash_fwd_kernel)
+        q = q_ref[0]                                     # (bq, d)
+        k = k_ref[0]                                     # (bk, d)
+        v = v_ref[0]                                     # (bk, d)
+        do = do_ref[0]                                   # (bq, d)
         lse = lse_ref[0]                                 # (bq, 1)
         delta = delta_ref[0]                             # (bq, 1)
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if causal or window > 0:
             rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
             cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
@@ -225,19 +265,21 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window > 0:
                 ok = ok & (rows - cols < window)
             s = jnp.where(ok, s, _NEG_INF)
-        p = jnp.exp(s - lse)                             # (bq, bk)
+        p = jnp.exp(s - lse)                             # (bq, bk) f32
+        pc = p.astype(v.dtype)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pc, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale                    # (bq, bk)
+        ds = p * (dp - delta) * scale                    # (bq, bk) f32
+        dsc = ds.astype(q.dtype)
         dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            dsc, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         rows = pl.dslice(qi * bq, bq)
         dq_scr[rows, :] = dq_scr[rows, :] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            dsc, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal or window > 0:
@@ -457,11 +499,14 @@ def flash_attention(query, key, value, scale=None, causal=False,
                     block_size=1024, window=0, native_gqa=False):
     """Memory-efficient attention. query/key/value: (B, H, T, D).
 
-    block_size sweep on v5e (fwd+bwd, T=4k, D=64): 128 -> 7, 256 -> 22,
-    512 -> 47.6, 1024 -> 50.6 TFLOP/s — bigger MXU ops amortize the
-    per-grid-step overhead; (bq, bk) clamp to (T, S) for short
-    sequences. 1024x1024 bf16 q/k/v/o blocks + f32 accumulators fit
-    v5e VMEM (~16 MB) at D<=128.
+    Kernel matmuls keep the INPUT dtype (bf16 on the training path)
+    with f32 MXU accumulation — the round-3 kernels upcast to fp32
+    first, which capped them at the ~51 TFLOP/s fp32 MXU ceiling;
+    bf16 operands measure 59-61 TFLOP/s fwd+bwd (T=4k, D=64, v5e).
+    block_size sweep with the bf16 kernels: 512 -> 45, 1024 -> 49-61
+    (run variance) — 1024 stays the default; (bq, bk) clamp to (T, S)
+    for short sequences. 1024x1024 bf16 q/k/v/o blocks + f32
+    accumulators fit v5e VMEM (~16 MB) at D<=128.
 
     Grouped-query attention (fewer kv heads, ``KVH | H``) is accepted
     directly; the default path repeats kv inside the op (measured 3x
@@ -473,12 +518,12 @@ def flash_attention(query, key, value, scale=None, causal=False,
 
     ``window > 0`` selects sliding-window (Mistral/Longformer-style
     local causal) attention: position i sees the last ``window``
-    positions only. Both Pallas kernels SKIP the compute of every block
-    outside the band, so FLOPs scale as O(T*window) instead of O(T^2)
-    (grid iteration and k/v block DMA still visit all T^2/(bq*bk)
-    cells — at T=16k/W=1k that still measures >2.5x faster wall-clock
-    than full causal; see tests_tpu). The sldwin_atten_* ops are the
-    dense op-surface analog."""
+    positions only. The forward kernel uses a BANDED grid: the kv grid
+    dimension covers only the blocks that can intersect the band, so
+    grid steps and k/v DMA scale as O(T*window) like the FLOPs
+    (measured: 8.7 -> 21.3 TFLOP/s at T=32k/W=1k on v5e). The backward
+    kernel skips out-of-band COMPUTE but still walks the full grid.
+    The sldwin_atten_* ops are the dense op-surface analog."""
     if scale is None:
         scale = 1.0 / (query.shape[-1] ** 0.5)
     if window and window < 0:
