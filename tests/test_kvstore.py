@@ -100,3 +100,38 @@ def test_row_sparse_pull():
     expected = np.zeros((4, 3), np.float32)
     expected[[1, 3]] = w.asnumpy()[[1, 3]]
     assert_almost_equal(out, expected)
+
+
+def test_grouped_pushpull_multidevice():
+    """The fused multi-key pushpull gathers per-device values to one
+    device before the single jitted sum (review regression: committed
+    arrays on different devices cannot feed one jit call)."""
+    import jax
+
+    import numpy as np
+
+    kv = mx.kv.create("device")
+    devs = jax.devices()
+    assert len(devs) >= 2
+    keys = ["a", "b", "c"]
+    shapes = [(4, 3), (5,), (2, 2)]
+    outs = []
+    vals = []
+    rng = np.random.RandomState(0)
+    expect = []
+    for k, sh in zip(keys, shapes):
+        kv.init(k, mx.nd.zeros(sh))
+        per_dev = []
+        tot = np.zeros(sh, np.float32)
+        for d in devs[:2]:
+            a = rng.rand(*sh).astype(np.float32)
+            tot += a
+            nd = mx.nd.array(a)
+            nd._set_data(jax.device_put(nd.data, d))
+            per_dev.append(nd)
+        vals.append(per_dev)
+        outs.append(mx.nd.zeros(sh))
+        expect.append(tot)
+    kv.pushpull(keys, vals, out=outs)
+    for o, e in zip(outs, expect):
+        np.testing.assert_allclose(o.asnumpy(), e, rtol=1e-6)
