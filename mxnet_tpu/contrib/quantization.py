@@ -78,35 +78,64 @@ class QuantizedNet:
 
     def __call__(self, x):
         raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        # (mn, mx) != None marks raw as LIVE int8 with that float range:
+        # relu/pool/flatten then run their quantized_* ops directly and
+        # the next conv/dense consumes the int8 without a re-quantize —
+        # activations stay int8 end-to-end between calibrated stages
+        qrange = None
         for kind, p in self._stages:
             if kind == "float":
+                if qrange is not None:
+                    raw, qrange = qops.dequantize(raw, *qrange), None
                 raw = p["fn"](raw)
-            elif kind == "conv":
-                q, _, _ = qops.quantize(raw, p["min_in"], p["max_in"])
-                acc, mn, mx = qops.quantized_conv(
-                    q, p["qw"], p["qb"], p["min_in"], p["max_in"],
-                    p["min_w"], p["max_w"], p.get("min_b"), p.get("max_b"),
-                    no_bias=p["qb"] is None, **p["kwargs"])
-                sa = 127.0 / max(abs(p["min_in"]), abs(p["max_in"]))
-                sw = 127.0 / max(abs(p["min_w"]), abs(p["max_w"]))
-                raw = acc.astype(jnp.float32) / (sa * sw)
-            elif kind == "dense":
-                q, _, _ = qops.quantize(raw, p["min_in"], p["max_in"])
-                acc, mn, mx = qops.quantized_fully_connected(
-                    q, p["qw"], p["qb"], p["min_in"], p["max_in"],
-                    p["min_w"], p["max_w"], p.get("min_b"), p.get("max_b"),
-                    no_bias=p["qb"] is None, flatten=p["flatten"])
-                sa = 127.0 / max(abs(p["min_in"]), abs(p["max_in"]))
-                sw = 127.0 / max(abs(p["min_w"]), abs(p["max_w"]))
-                raw = acc.astype(jnp.float32) / (sa * sw)
+            elif kind in ("conv", "dense"):
+                if qrange is None:
+                    q, _, _ = qops.quantize(raw, p["min_in"], p["max_in"])
+                    rng = (p["min_in"], p["max_in"])
+                else:
+                    q, rng = raw, qrange
+                if kind == "conv":
+                    acc, mn32, mx32 = qops.quantized_conv(
+                        q, p["qw"], p["qb"], rng[0], rng[1],
+                        p["min_w"], p["max_w"], p.get("min_b"),
+                        p.get("max_b"), no_bias=p["qb"] is None,
+                        **p["kwargs"])
+                else:
+                    acc, mn32, mx32 = qops.quantized_fully_connected(
+                        q, p["qw"], p["qb"], rng[0], rng[1],
+                        p["min_w"], p["max_w"], p.get("min_b"),
+                        p.get("max_b"), no_bias=p["qb"] is None,
+                        flatten=p["flatten"])
+                if p.get("min_out") is not None:
+                    # calibrated requantize: int32 acc -> int8, stage
+                    # output STAYS quantized (reference requantize path)
+                    raw, lo, hi = qops.requantize(
+                        acc, mn32, mx32, p["min_out"], p["max_out"])
+                    qrange = (lo, hi)
+                else:
+                    sa = 127.0 / max(abs(rng[0]), abs(rng[1]))
+                    sw = 127.0 / max(abs(p["min_w"]), abs(p["max_w"]))
+                    raw, qrange = acc.astype(jnp.float32) / (sa * sw), None
             elif kind == "relu":
-                raw = jnp.maximum(raw, 0.0)
+                if qrange is not None:
+                    raw, lo, hi = qops.quantized_act(raw, *qrange,
+                                                     act_type="relu")
+                    qrange = (lo, hi)
+                else:
+                    raw = jnp.maximum(raw, 0.0)
             elif kind == "pool":
-                raw = p["fn"](raw)
+                if qrange is not None:
+                    raw, lo, hi = qops.quantized_pooling(raw, *qrange,
+                                                         **p["kwargs"])
+                    qrange = (lo, hi)
+                else:
+                    raw = p["fn"](raw)
             elif kind == "flatten":
                 raw = raw.reshape(raw.shape[0], -1)
             else:  # pragma: no cover
                 raise MXNetError(f"unknown stage {kind}")
+        if qrange is not None:
+            raw = qops.dequantize(raw, *qrange)
         return NDArray(raw)
 
 
@@ -192,8 +221,11 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                 f"quantize_net: unsupported layer {type(layer).__name__}")
         i += 1
 
-    # --- calibration: record input ranges of quantizable stages ----------
-    ranges = {}  # stage index -> [min, max]
+    # --- calibration: record input AND output ranges of quantizable
+    # stages (outputs feed the requantize that keeps activations int8
+    # through relu/pool chains) ------------------------------------------
+    ranges = {}  # stage index -> [min, max] of the stage INPUT
+    out_ranges = {}  # stage index -> [min, max] of the stage OUTPUT
     samples = {}  # stage index -> list of |x| samples (entropy mode)
     if calib_data is None:
         raise MXNetError("calib_data is required for calibration")
@@ -226,6 +258,12 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                     raw = _float_conv(raw, w, b, kw)
                 else:
                     raw = _float_dense(raw, w, b, layer._flatten)
+                olo, ohi = float(jnp.min(raw)), float(jnp.max(raw))
+                if si in out_ranges:
+                    out_ranges[si][0] = min(out_ranges[si][0], olo)
+                    out_ranges[si][1] = max(out_ranges[si][1], ohi)
+                else:
+                    out_ranges[si] = [olo, ohi]
             elif kind == "relu":
                 raw = jnp.maximum(raw, 0.0)
             elif kind == "pool":
@@ -273,6 +311,9 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
             payload = _quantize_weights(w, b)
             mn, mx = ranges[si]
             payload.update(min_in=mn, max_in=mx)
+            if si in out_ranges:
+                payload.update(min_out=out_ranges[si][0],
+                               max_out=out_ranges[si][1])
             if kind == "conv":
                 payload["kwargs"] = dict(layer._kwargs)
                 payload["kwargs"].pop("no_bias", None)
@@ -283,6 +324,7 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
         elif kind == "pool":
             lay = layer
             stages.append(("pool", {
+                "kwargs": dict(lay._kwargs),
                 "fn": (lambda r, _l=lay: _l(NDArray(r)).data)}))
         else:
             stages.append((kind, None))

@@ -148,7 +148,7 @@ def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
 @register("quantized_pooling", aliases=("_contrib_quantized_pooling",))
 def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
                       global_pool=False, stride=(), pad=(),
-                      pooling_convention="valid"):
+                      pooling_convention="valid", count_include_pad=True):
     """Pooling stays in int8 (max) / int32 (avg) — ranges pass through."""
     nd = data.ndim - 2
     if global_pool:
@@ -160,16 +160,32 @@ def quantized_pooling(data, min_data, max_data, kernel=(), pool_type="max",
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
     pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode: extra right-padding so the last window fits (same
+        # arithmetic as the float Pooling op)
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra.append((stride[i] - rem) % stride[i]
+                         if size > kernel[i] else 0)
+        pads = ((0, 0), (0, 0)) + tuple(
+            (pad[i], pad[i] + extra[i]) for i in range(nd))
     if pool_type == "max":
         init = jnp.asarray(jnp.iinfo(data.dtype).min, data.dtype)
         out = lax.reduce_window(data, init, lax.max, window, strides, pads)
     else:
         s = lax.reduce_window(data.astype(jnp.int32), 0, lax.add, window,
                               strides, pads)
-        cnt = 1
-        for k in kernel:
-            cnt *= k
-        out = (s // cnt).astype(data.dtype)
+        if count_include_pad:
+            cnt = 1
+            for k in kernel:
+                cnt *= k
+            out = (s // cnt).astype(data.dtype)
+        else:
+            ones = jnp.ones(data.shape, jnp.int32)
+            cnt = lax.reduce_window(ones, 0, lax.add, window, strides, pads)
+            out = (s // jnp.maximum(cnt, 1)).astype(data.dtype)
     return out, min_data, max_data
 
 
@@ -180,13 +196,53 @@ def quantized_flatten(data, min_data, max_data):
 
 @register("quantized_act", aliases=("_contrib_quantized_act",))
 def quantized_act(data, min_data, max_data, act_type="relu"):
-    """int8 relu: clamp negatives; range floor rises to 0 (reference
-    quantized_activation.cc)."""
-    if act_type != "relu":
-        raise NotImplementedError("only relu is quantized; others "
-                                  "dequantize around the op")
-    zero = jnp.asarray(0, data.dtype)
-    return jnp.maximum(data, zero), jnp.maximum(min_data, 0.0), max_data
+    """int8 activations (reference quantized_activation.cc).
+
+    relu stays in int8 (clamp + range floor). sigmoid/tanh pass through
+    a float evaluation and re-quantize into their FIXED output ranges
+    ([0,1] / [-1,1]) — the saturating shape makes a lookup-table / float
+    round-trip the standard int8 treatment; softrelu likewise with the
+    data-range upper bound."""
+    if act_type == "relu":
+        zero = jnp.asarray(0, data.dtype)
+        return jnp.maximum(data, zero), jnp.maximum(min_data, 0.0), max_data
+    scale = 1.0 / _symmetric_scale(min_data, max_data)
+    f = data.astype(jnp.float32) * scale
+    if act_type == "sigmoid":
+        out = 1.0 / (1.0 + jnp.exp(-f))
+        mn, mx = jnp.asarray(0.0), jnp.asarray(1.0)
+    elif act_type == "tanh":
+        out = jnp.tanh(f)
+        mn, mx = jnp.asarray(-1.0), jnp.asarray(1.0)
+    elif act_type == "softrelu":
+        out = jnp.log1p(jnp.exp(f))
+        mn = jnp.asarray(0.0)
+        mx = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    else:
+        raise NotImplementedError(
+            f"quantized activation '{act_type}' is not supported")
+    q, qmn, qmx = quantize(out, mn, mx, out_type="int8")
+    return q, qmn, qmx
+
+
+@register("quantized_concat", aliases=("_contrib_quantized_concat",))
+def quantized_concat(*args, dim=1):
+    """int8 concat with range unification (reference quantized_concat.cc):
+    inputs are ``n`` int8 tensors followed by their ``n`` mins and ``n``
+    maxs; every tensor is rescaled into the widest range so one (min,
+    max) pair describes the output."""
+    n = len(args) // 3
+    data, mins, maxs = args[:n], args[n:2 * n], args[2 * n:3 * n]
+    out_absmax = jnp.maximum(jnp.abs(jnp.asarray(mins)),
+                             jnp.abs(jnp.asarray(maxs))).max()
+    out_scale = 127.0 / jnp.maximum(out_absmax, 1e-30)
+    parts = []
+    for d, mn, mx in zip(data, mins, maxs):
+        in_scale = _symmetric_scale(mn, mx)
+        parts.append(jnp.clip(
+            jnp.round(d.astype(jnp.float32) * (out_scale / in_scale)),
+            -127, 127).astype(jnp.int8))
+    return (jnp.concatenate(parts, axis=dim), -out_absmax, out_absmax)
 
 
 # ---------------------------------------------------------------------------
