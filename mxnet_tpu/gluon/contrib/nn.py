@@ -111,3 +111,62 @@ class MoEDense(HybridBlock):
                                   axis_name=self._axis,
                                   capacity_factor=self._cf)
         return F.reshape_like(out, x), aux
+
+
+class SpectralNorm(HybridBlock):
+    """Spectral weight normalization wrapper (power iteration).
+
+    Wraps a block with a ``weight`` parameter (Dense / Conv2D) and
+    divides that weight by its largest singular value, estimated by
+    ``num_power_iter`` rounds of power iteration on a persistent ``u``
+    vector (Miyato et al.; the GAN-regularization layer the reference
+    ecosystem ships in gluon contrib)."""
+
+    def __init__(self, module, num_power_iter=1, epsilon=1e-12, **kwargs):
+        super().__init__(**kwargs)
+        if not hasattr(module, "weight"):
+            from ...base import MXNetError
+
+            raise MXNetError("SpectralNorm expects a block with a "
+                             f"'weight' parameter; got {type(module).__name__}")
+        self._iters = int(num_power_iter)
+        self._eps = float(epsilon)
+        with self.name_scope():
+            self.module = module
+            out_dim = module.weight.shape[0] if module.weight.shape else 0
+            self.u = self.params.get(
+                "u", shape=(1, out_dim) if out_dim else None,
+                init="normal", grad_req="null",
+                allow_deferred_init=True)
+
+    def forward(self, x):
+        from ... import autograd as _ag
+        from ...ndarray import op as F
+        from ...ndarray.ndarray import NDArray
+
+        import jax.numpy as jnp
+
+        w_param = self.module.weight
+        handle = w_param.data()
+        wmat = handle.data.reshape(handle.shape[0], -1)
+        if self.u.shape is None or self.u.shape != (1, handle.shape[0]):
+            self.u.shape = (1, handle.shape[0])
+            self.u._finish_deferred_init()
+        u = self.u.data().data
+        # power iteration OUTSIDE the tape (standard SN: u/v detached;
+        # the 1/sigma factor is treated as a constant w.r.t. the weight)
+        for _ in range(self._iters):
+            v = jnp.matmul(u, wmat)
+            v = v / (jnp.linalg.norm(v) + self._eps)
+            u = jnp.matmul(v, wmat.T)
+            u = u / (jnp.linalg.norm(u) + self._eps)
+        sigma = jnp.sum((u @ wmat) * v)
+        with _ag.pause():
+            self.u.set_data(NDArray(u))
+        saved = handle._data_
+        try:
+            handle._data_ = (saved / jnp.maximum(sigma, self._eps)) \
+                .astype(saved.dtype)
+            return self.module(x)
+        finally:
+            handle._data_ = saved

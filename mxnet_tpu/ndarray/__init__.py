@@ -24,6 +24,7 @@ from .ndarray import (  # noqa: F401
     imdecode,
 )
 from . import op  # noqa: F401
+from . import _internal  # noqa: F401
 from .op import *  # noqa: F401,F403
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401

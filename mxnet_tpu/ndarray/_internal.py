@@ -1,0 +1,21 @@
+"""``mx.nd._internal`` (reference: ``python/mxnet/ndarray/_internal.py``).
+
+The reference generates underscore-prefixed op stubs (``_plus_scalar``,
+``_rdiv_scalar``, ...) into this module; Python operator lowering and
+saved symbol JSON graphs refer to these names. Here they alias the same
+registry-driven wrappers as ``mx.nd.op``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from . import op as _op
+
+_THIS = sys.modules[__name__]
+
+for _name in list(_registry.all_ops()):
+    if _name.startswith("_") and hasattr(_op, _name) \
+            and not hasattr(_THIS, _name):
+        setattr(_THIS, _name, getattr(_op, _name))

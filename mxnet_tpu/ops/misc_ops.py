@@ -402,3 +402,86 @@ def onehot_encode(indices, out_like):
     n, k = out_like.shape
     return (indices.astype(jnp.int32)[:, None]
             == jnp.arange(k, dtype=jnp.int32)[None, :]).astype(out_like.dtype)
+
+
+# ---------------------------------------------------------------------------
+# scalar-operand elemwise family (reference:
+# src/operator/tensor/elemwise_binary_scalar_op_basic.cc etc.). These are
+# the names Python operator lowering emits in the reference (x + 2 ->
+# _plus_scalar), so saved symbol JSON graphs reference them directly —
+# the interchange path needs them resolvable by name.
+# ---------------------------------------------------------------------------
+
+def _scalar_op(name, fn, aliases=()):
+    @register(name, aliases=aliases)
+    def op(data, scalar=1.0, is_int=True):
+        return fn(data, jnp.asarray(scalar, data.dtype))
+    op.__name__ = name
+    op.__doc__ = f"(reference: ``{name}`` scalar elemwise op)."
+    return op
+
+
+_scalar_op("_plus_scalar", lambda x, s: x + s, aliases=("_PlusScalar",))
+_scalar_op("_minus_scalar", lambda x, s: x - s, aliases=("_MinusScalar",))
+_scalar_op("_rminus_scalar", lambda x, s: s - x, aliases=("_RMinusScalar",))
+_scalar_op("_mul_scalar", lambda x, s: x * s, aliases=("_MulScalar",))
+_scalar_op("_div_scalar", lambda x, s: x / s, aliases=("_DivScalar",))
+_scalar_op("_rdiv_scalar", lambda x, s: s / x, aliases=("_RDivScalar",))
+_scalar_op("_mod_scalar", lambda x, s: jnp.mod(x, s), aliases=("_ModScalar",))
+_scalar_op("_rmod_scalar", lambda x, s: jnp.mod(s, x),
+           aliases=("_RModScalar",))
+_scalar_op("_power_scalar", lambda x, s: jnp.power(x, s),
+           aliases=("_PowerScalar",))
+_scalar_op("_rpower_scalar", lambda x, s: jnp.power(s, x),
+           aliases=("_RPowerScalar",))
+_scalar_op("_maximum_scalar", lambda x, s: jnp.maximum(x, s),
+           aliases=("_MaximumScalar",))
+_scalar_op("_minimum_scalar", lambda x, s: jnp.minimum(x, s),
+           aliases=("_MinimumScalar",))
+_scalar_op("_hypot_scalar", lambda x, s: jnp.hypot(x, s),
+           aliases=("_HypotScalar",))
+_scalar_op("_equal_scalar", lambda x, s: (x == s).astype(x.dtype))
+_scalar_op("_not_equal_scalar", lambda x, s: (x != s).astype(x.dtype))
+_scalar_op("_greater_scalar", lambda x, s: (x > s).astype(x.dtype))
+_scalar_op("_greater_equal_scalar", lambda x, s: (x >= s).astype(x.dtype))
+_scalar_op("_lesser_scalar", lambda x, s: (x < s).astype(x.dtype))
+_scalar_op("_lesser_equal_scalar", lambda x, s: (x <= s).astype(x.dtype))
+_scalar_op("_logical_and_scalar",
+           lambda x, s: jnp.logical_and(x, s).astype(x.dtype))
+_scalar_op("_logical_or_scalar",
+           lambda x, s: jnp.logical_or(x, s).astype(x.dtype))
+_scalar_op("_logical_xor_scalar",
+           lambda x, s: jnp.logical_xor(x, s).astype(x.dtype))
+
+
+@register("logical_and")
+def logical_and(lhs, rhs):
+    """(reference: ``_logical_and`` / np.logical_and elemwise)."""
+    return jnp.logical_and(lhs, rhs).astype(lhs.dtype)
+
+
+@register("logical_or")
+def logical_or(lhs, rhs):
+    return jnp.logical_or(lhs, rhs).astype(lhs.dtype)
+
+
+@register("logical_xor")
+def logical_xor(lhs, rhs):
+    return jnp.logical_xor(lhs, rhs).astype(lhs.dtype)
+
+
+@register("_grad_add")
+def _grad_add(lhs, rhs):
+    """Gradient accumulation add (reference: ``_grad_add`` — plain add;
+    the reference distinguishes it for inplace-addto planning, which XLA
+    owns here)."""
+    return lhs + rhs
+
+
+@register("trapz")
+def trapz(y, x=None, dx=1.0, axis=-1):
+    """Trapezoidal integration (numpy semantics; ``mx.np.trapz`` routes
+    through the same implementation)."""
+    if x is None:
+        return jnp.trapezoid(y, dx=dx, axis=axis)
+    return jnp.trapezoid(y, x, axis=axis)

@@ -9,6 +9,7 @@ from .symbol import (  # noqa: F401
     load_json,
 )
 from . import op  # noqa: F401
+from . import _internal  # noqa: F401
 from .op import *  # noqa: F401,F403
 from .executor import Executor, eval_symbol  # noqa: F401
 from . import op as _op_mod

@@ -1,0 +1,18 @@
+"""``mx.sym._internal`` (reference: ``python/mxnet/symbol/_internal.py``).
+
+Underscore-prefixed symbolic op stubs — see ``ndarray/_internal.py``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from . import op as _op
+
+_THIS = sys.modules[__name__]
+
+for _name in list(_registry.all_ops()):
+    if _name.startswith("_") and hasattr(_op, _name) \
+            and not hasattr(_THIS, _name):
+        setattr(_THIS, _name, getattr(_op, _name))

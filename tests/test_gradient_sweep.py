@@ -153,7 +153,7 @@ EXCLUDED = {
     "quantize": QUANT, "quantize_v2": QUANT, "quantize_2bit": QUANT,
     "quantized_act": QUANT, "quantized_conv": QUANT,
     "quantized_flatten": QUANT, "quantized_fully_connected": QUANT,
-    "quantized_pooling": QUANT, "requantize": QUANT, "dequantize": QUANT,
+    "quantized_pooling": QUANT, "quantized_concat": QUANT, "requantize": QUANT, "dequantize": QUANT,
     "calibrate_entropy": QUANT,
     "intgemm_fully_connected": QUANT, "intgemm_maxabsolute": QUANT,
     "intgemm_prepare_data": QUANT, "intgemm_prepare_weight": QUANT,
@@ -588,6 +588,32 @@ SPECS["random_pdf_generalized_negative_binomial"] = lambda: (
 
 # misc
 SPECS["div_sqrt_dim"] = unary("div_sqrt_dim")
+
+# scalar-operand family (round-4 additions)
+for _n in ["_plus_scalar", "_minus_scalar", "_rminus_scalar", "_mul_scalar"]:
+    SPECS[_n] = unary(_n, scalar=1.7)
+SPECS["_div_scalar"] = unary("_div_scalar", scalar=1.7)
+SPECS["_rdiv_scalar"] = unary("_rdiv_scalar", dom=away0, scalar=1.7)
+SPECS["_power_scalar"] = unary("_power_scalar", dom=pos, scalar=2.3)
+SPECS["_rpower_scalar"] = unary("_rpower_scalar", scalar=1.8)
+SPECS["_maximum_scalar"] = unary("_maximum_scalar", dom=lambda: distinct() + 0.07,
+                                 scalar=0.0)
+SPECS["_minimum_scalar"] = unary("_minimum_scalar", dom=lambda: distinct() + 0.07,
+                                 scalar=0.0)
+SPECS["_hypot_scalar"] = unary("_hypot_scalar", dom=away0, scalar=1.1)
+SPECS["_grad_add"] = binary("_grad_add")
+SPECS["trapz"] = unary("trapz", shape=(5,))
+EXCLUDED.update({
+    "_equal_scalar": NONDIFF, "_not_equal_scalar": NONDIFF,
+    "_greater_scalar": NONDIFF, "_greater_equal_scalar": NONDIFF,
+    "_lesser_scalar": NONDIFF, "_lesser_equal_scalar": NONDIFF,
+    "_logical_and_scalar": NONDIFF, "_logical_or_scalar": NONDIFF,
+    "_logical_xor_scalar": NONDIFF,
+    "logical_and": NONDIFF, "logical_or": NONDIFF, "logical_xor": NONDIFF,
+    "_mod_scalar": "piecewise-constant w.r.t. scalar modulus, kinks at "
+                   "multiples",
+    "_rmod_scalar": "piecewise-constant, kinks at multiples",
+})
 SPECS["logsumexp2"] = None
 del SPECS["logsumexp2"]
 SPECS["pick2"] = None
