@@ -31,3 +31,14 @@ from .image import (  # noqa: F401
     SaturationJitterAug,
     ImageIter,
 )
+from .detection import (  # noqa: F401
+    DetAugmenter,
+    DetBorrowAug,
+    DetRandomSelectAug,
+    DetHorizontalFlipAug,
+    DetRandomCropAug,
+    DetRandomPadAug,
+    DetForceResizeAug,
+    CreateDetAugmenter,
+    ImageDetIter,
+)

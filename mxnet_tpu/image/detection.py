@@ -1,0 +1,355 @@
+"""Detection-aware image augmentation + ImageDetIter.
+
+Reference: ``python/mxnet/image/detection.py`` (``ImageDetIter``,
+``CreateDetAugmenter``, ``DetRandomCropAug``, ``DetRandomPadAug``,
+``DetHorizontalFlipAug``, ``DetBorrowAug``) and the native pipeline in
+``src/io/image_det_aug_default.cc``.
+
+Label convention (the reference's): per image a 2D float array
+``(num_objects, width>=5)`` with rows ``[class_id, xmin, ymin, xmax,
+ymax, ...]`` in coordinates normalized to [0, 1]. In ``.lst``/``.rec``
+headers the label is flattened as ``[A, B, <A-2 extras>, objects...]``
+where ``A`` is the header width (>= 2) and ``B`` the per-object width.
+Augmenters transform image AND boxes together; boxes whose remaining
+visible fraction drops below ``min_eject_coverage`` after a crop are
+ejected, exactly the semantics SSD/YOLO training relies on.
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array as _array
+from .image import (Augmenter, CreateAugmenter, fixed_crop, imresize,
+                    ImageIter)
+
+
+class DetAugmenter:
+    """Detection augmenter base: ``__call__(src, label) -> (src, label)``
+    with ``src`` an HWC image NDArray and ``label`` an (N, >=5) numpy
+    array of normalized boxes."""
+
+    def __call__(self, src, label):
+        return src, label
+
+
+class DetBorrowAug(DetAugmenter):
+    """Borrow a pixel-only augmenter (color jitter, cast, normalize...)
+    whose transform does not move pixels — boxes pass through."""
+
+    def __init__(self, augmenter):
+        if not isinstance(augmenter, Augmenter):
+            raise MXNetError("DetBorrowAug expects an image Augmenter")
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select ONE augmenter from a list (or skip entirely with
+    probability ``skip_prob``)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if not self.aug_list or _pyrandom.random() < self.skip_prob:
+            return src, label
+        return _pyrandom.choice(self.aug_list)(src, label)
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and x-coordinates with probability ``p``."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = NDArray(src.data[:, ::-1, :])
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+def _box_crop_overlap(label, crop):
+    """Visible fraction of each box inside ``crop=(x0,y0,x1,y1)``
+    (normalized units)."""
+    ix0 = _np.maximum(label[:, 1], crop[0])
+    iy0 = _np.maximum(label[:, 2], crop[1])
+    ix1 = _np.minimum(label[:, 3], crop[2])
+    iy1 = _np.minimum(label[:, 4], crop[3])
+    iw = _np.maximum(0.0, ix1 - ix0)
+    ih = _np.maximum(0.0, iy1 - iy0)
+    area = _np.maximum((label[:, 3] - label[:, 1])
+                       * (label[:, 4] - label[:, 2]), 1e-12)
+    return iw * ih / area
+
+
+def _update_labels_crop(label, crop, min_eject_coverage):
+    """Remap boxes into crop coordinates, ejecting mostly-hidden ones
+    (reference ``DetRandomCropAug._update_labels``)."""
+    cov = _box_crop_overlap(label, crop)
+    keep = cov >= min_eject_coverage
+    out = label[keep].copy()
+    cw, ch = crop[2] - crop[0], crop[3] - crop[1]
+    out[:, 1] = (_np.clip(out[:, 1], crop[0], crop[2]) - crop[0]) / cw
+    out[:, 3] = (_np.clip(out[:, 3], crop[0], crop[2]) - crop[0]) / cw
+    out[:, 2] = (_np.clip(out[:, 2], crop[1], crop[3]) - crop[1]) / ch
+    out[:, 4] = (_np.clip(out[:, 4], crop[1], crop[3]) - crop[1]) / ch
+    return out
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference ``DetRandomCropAug`` /
+    SSD-paper sampling): propose crops by area and aspect ratio until
+    at least one object keeps ``min_object_covered`` of its area inside
+    the crop; surviving boxes are clipped and renormalized, and boxes
+    left with less than ``min_eject_coverage`` visible are dropped."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(0.05, 1.0), min_eject_coverage=0.3,
+                 max_attempts=50):
+        if not 0 < area_range[1] <= 1:
+            raise MXNetError(f"area_range must be in (0, 1]; got {area_range}")
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def _propose(self, label):
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            w = min((area * ratio) ** 0.5, 1.0)
+            h = min((area / ratio) ** 0.5, 1.0)
+            x0 = _pyrandom.uniform(0.0, 1.0 - w)
+            y0 = _pyrandom.uniform(0.0, 1.0 - h)
+            crop = (x0, y0, x0 + w, y0 + h)
+            if label.size == 0:
+                return crop
+            if (_box_crop_overlap(label, crop)
+                    >= self.min_object_covered).any():
+                return crop
+        return None
+
+    def __call__(self, src, label):
+        crop = self._propose(label)
+        if crop is None:
+            return src, label
+        h, w = src.shape[0], src.shape[1]
+        x0, y0 = int(crop[0] * w), int(crop[1] * h)
+        cw = max(1, int((crop[2] - crop[0]) * w))
+        ch = max(1, int((crop[3] - crop[1]) * h))
+        src = fixed_crop(src, x0, y0, cw, ch)
+        return src, _update_labels_crop(label, crop, self.min_eject_coverage)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion (reference ``DetRandomPadAug``): place the image
+    on a larger ``pad_val`` canvas; boxes scale down accordingly. The
+    standard SSD 'zoom-out' augmentation for small objects."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33), area_range=(1.0, 3.0),
+                 max_attempts=50, pad_val=(127, 127, 127)):
+        if area_range[0] < 1.0:
+            raise MXNetError(
+                f"pad area_range must be >= 1; got {area_range}")
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(*self.area_range)
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            nw = int(w * (area * ratio) ** 0.5)
+            nh = int(h * (area / ratio) ** 0.5)
+            if nw >= w and nh >= h:
+                break
+        else:
+            return src, label
+        x0 = _pyrandom.randint(0, nw - w)
+        y0 = _pyrandom.randint(0, nh - h)
+        img = _np.asarray(src.asnumpy())
+        canvas = _np.empty((nh, nw, img.shape[2]), img.dtype)
+        canvas[:] = _np.asarray(self.pad_val, img.dtype)[:img.shape[2]]
+        canvas[y0:y0 + h, x0:x0 + w] = img
+        out = label.copy()
+        out[:, 1] = (out[:, 1] * w + x0) / nw
+        out[:, 3] = (out[:, 3] * w + x0) / nw
+        out[:, 2] = (out[:, 2] * h + y0) / nh
+        out[:, 4] = (out[:, 4] * h + y0) / nh
+        return _array(canvas), out
+
+
+class DetForceResizeAug(DetAugmenter):
+    """Resize to exactly (w, h); normalized boxes are unchanged."""
+
+    def __init__(self, size, interp=2):
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1],
+                        interp=self.interp), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """Build the standard detection augmentation chain (reference:
+    ``CreateDetAugmenter``). ``rand_crop``/``rand_pad`` are the
+    PROBABILITIES of applying the random crop / expansion."""
+    auglist = []
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                min_eject_coverage, max_attempts)
+        auglist.append(DetRandomSelectAug([crop], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # force-resize to the network input LAST (after geometry changes)
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
+                                     inter_method))
+    # borrowed pixel-only augmenters
+    color = CreateAugmenter((data_shape[0], data_shape[1], data_shape[2]),
+                            brightness=brightness, contrast=contrast,
+                            saturation=saturation, mean=mean, std=std) \
+        if (brightness or contrast or saturation or mean is not None
+            or std is not None) else []
+    for aug in color:
+        if type(aug).__name__ in ("BrightnessJitterAug", "ContrastJitterAug",
+                                  "SaturationJitterAug", "CastAug",
+                                  "ColorNormalizeAug"):
+            auglist.append(DetBorrowAug(aug))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over ``.rec``/``.lst``/``imglist`` with
+    label-aware augmentation (reference: ``image.ImageDetIter``).
+
+    Yields ``DataBatch`` with data ``(B, C, H, W)`` and label
+    ``(B, max_objects, obj_width)`` padded with -1 rows."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, dtype="float32",
+                 label_pad_width=None, label_pad_value=-1.0, **kwargs):
+        if aug_list is None:
+            aug_list = CreateDetAugmenter(data_shape, **kwargs)
+        self._det_auglist = aug_list
+        super().__init__(batch_size, data_shape, label_width=1,
+                         path_imgrec=path_imgrec, path_imglist=path_imglist,
+                         path_root=path_root, shuffle=shuffle, aug_list=[],
+                         imglist=imglist, dtype=dtype)
+        self.label_pad_value = label_pad_value
+        max_obj, width = self._estimate_label_shape()
+        self.max_objects = label_pad_width or max_obj
+        self.obj_width = width
+        self.provide_label = [("label", (batch_size, self.max_objects,
+                                         self.obj_width))]
+
+    @staticmethod
+    def _parse_det_label(label):
+        """Flat header label -> (N, width) objects (reference
+        ``_parse_label``: ``[A, B, extras..., objs...]``)."""
+        raw = _np.asarray(label, _np.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError(f"detection label too short: {raw.size}")
+        A, B = int(raw[0]), int(raw[1])
+        if A < 2 or B < 5:
+            raise MXNetError(f"invalid det label header A={A} B={B}")
+        body = raw[A:]
+        n = body.size // B
+        if n * B != body.size:
+            raise MXNetError(
+                f"label body size {body.size} not divisible by width {B}")
+        return body[:n * B].reshape(n, B)
+
+    def _estimate_label_shape(self):
+        """One pass over the dataset for (max_objects, width) — the
+        reference does the same to fix the padded label shape."""
+        max_obj, width = 0, 5
+        self.reset()
+        try:
+            while True:
+                label, _ = self.next_sample()
+                obj = self._parse_det_label(label)
+                max_obj = max(max_obj, obj.shape[0])
+                width = max(width, obj.shape[1])
+        except StopIteration:
+            pass
+        self.reset()
+        if max_obj == 0:
+            raise MXNetError("no detection labels found")
+        return max_obj, width
+
+    def sync_label_shape(self, it, verbose=False):
+        """Make this and another ImageDetIter agree on the padded label
+        shape (reference: train/val iter synchronization)."""
+        if not isinstance(it, ImageDetIter):
+            raise MXNetError("sync_label_shape expects an ImageDetIter")
+        n = max(self.max_objects, it.max_objects)
+        w = max(self.obj_width, it.obj_width)
+        for obj in (self, it):
+            obj.max_objects, obj.obj_width = n, w
+            obj.provide_label = [("label", (obj.batch_size, n, w))]
+        return it
+
+    def _pad_label(self, obj):
+        out = _np.full((self.max_objects, self.obj_width),
+                       self.label_pad_value, _np.float32)
+        if obj.shape[0] > self.max_objects:
+            raise MXNetError(
+                f"{obj.shape[0]} objects exceed label pad "
+                f"{self.max_objects}; pass label_pad_width")
+        out[:obj.shape[0], :obj.shape[1]] = obj
+        return out
+
+    def next(self):
+        from ..io import DataBatch
+        from .image import imdecode
+        import jax.numpy as jnp
+
+        batch_data, batch_label = [], []
+        pad = 0
+        try:
+            while len(batch_data) < self.batch_size:
+                label, s = self.next_sample()
+                data = imdecode(s)
+                obj = self._parse_det_label(label)
+                for aug in self._det_auglist:
+                    data, obj = aug(data, obj)
+                batch_data.append(jnp.transpose(
+                    data.data.astype(self.dtype), (2, 0, 1)))
+                batch_label.append(self._pad_label(obj))
+        except StopIteration:
+            if not batch_data:
+                raise
+            while len(batch_data) < self.batch_size:
+                pad += 1
+                batch_data.append(batch_data[-1])
+                batch_label.append(batch_label[-1])
+        return DataBatch(data=[NDArray(jnp.stack(batch_data))],
+                         label=[_array(_np.stack(batch_label))], pad=pad)

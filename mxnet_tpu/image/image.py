@@ -398,6 +398,7 @@ class ImageIter:
     def next(self):
         batch_data = []
         batch_label = []
+        pad = 0
         try:
             while len(batch_data) < self.batch_size:
                 label, s = self.next_sample()
@@ -411,13 +412,14 @@ class ImageIter:
             if not batch_data:
                 raise
             while len(batch_data) < self.batch_size:  # pad
+                pad += 1
                 batch_data.append(batch_data[-1])
                 batch_label.append(batch_label[-1])
         from ..io import DataBatch
 
         data = NDArray(jnp.stack(batch_data))
         label = _array(_np.stack(batch_label))
-        return DataBatch(data=[data], label=[label], pad=0)
+        return DataBatch(data=[data], label=[label], pad=pad)
 
     def __next__(self):
         return self.next()
