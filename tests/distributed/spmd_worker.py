@@ -1,0 +1,81 @@
+"""Multi-process x multi-device SPMD train-step worker (VERDICT r3 item
+8: the real v5e topology is N hosts x M local chips; the launcher tests
+only covered N procs x 1 device and the dryrun 1 proc x 8 devices).
+
+Run under ``tools/launch.py -n 2`` with
+``XLA_FLAGS=--xla_force_host_platform_device_count=4`` -> a 2-process,
+8-device global mesh running the fused ``SPMDTrainStep`` with dp x tp
+sharding. Also runs standalone (1 process, 8 local devices) as the
+equivalence reference: the final loss must match the multi-process run
+bit-for-bit (same global batch, same init, same update order).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from jax.sharding import PartitionSpec as P
+
+if "MXTPU_COORDINATOR" in os.environ:
+    from mxnet_tpu.kvstore.dist import init_distributed
+
+    init_distributed()
+    nprocs = int(os.environ["MXTPU_NUM_PROCESSES"])
+    rank = int(os.environ["MXTPU_PROCESS_ID"])
+    assert jax.process_count() == nprocs, (jax.process_count(), nprocs)
+else:
+    nprocs, rank = 1, 0
+
+assert jax.device_count() == 8, jax.device_count()
+assert jax.local_device_count() == 8 // nprocs
+
+mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+
+# deterministic model: params from a fixed seed on every process
+rng = np.random.RandomState(0)
+net = gluon.nn.HybridSequential()
+net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+        gluon.nn.Dense(8, in_units=32))
+net.initialize(init=mx.initializer.Constant(0.0))
+for name, p in sorted(net.collect_params().items()):
+    p.set_data(mx.nd.array(rng.uniform(-0.2, 0.2, p.shape)
+                           .astype(np.float32)))
+
+# tensor-parallel shardings for the hidden layer, dp batch sharding
+sharding = {}
+for name in net.collect_params():
+    if "dense0_weight" in name:
+        sharding[name] = P("tp", None)   # (32, 16) row-sharded over tp
+    elif "dense0_bias" in name:
+        sharding[name] = P("tp")
+    elif "dense1_weight" in name:
+        sharding[name] = P(None, "tp")   # (8, 32) col-sharded over tp
+loss_fn = gluon.loss.L2Loss()
+step = parallel.SPMDTrainStep(net, loss_fn, "sgd", {"momentum": 0.9},
+                              mesh=mesh, batch_axis="dp",
+                              param_sharding=sharding)
+
+X = rng.uniform(-1, 1, (16, 16)).astype(np.float32)
+Y = rng.uniform(-1, 1, (16, 8)).astype(np.float32)
+
+first = None
+loss = None
+for it in range(20):
+    loss = float(step(mx.nd.array(X), mx.nd.array(Y), lr=0.2))
+    if first is None:
+        first = loss
+final = loss
+assert final < first, (first, final)  # it actually trains
+print(f"SPMD_WORKER_OK rank={rank}/{nprocs} loss={final:.10f}", flush=True)

@@ -103,3 +103,50 @@ def test_sharded_checkpoint_multiprocess(tmp_path):
         f"stderr:\n{res.stderr[-4000:]}")
     for rank in range(2):
         assert f"CKPT_WORKER_OK rank={rank}/2" in res.stdout, res.stdout[-2000:]
+
+
+SPMD_WORKER = os.path.join(ROOT, "tests", "distributed", "spmd_worker.py")
+
+
+def test_spmd_step_multiprocess_multidevice():
+    """VERDICT r3 item 8: the real pod topology is N hosts x M local
+    chips. Run the fused SPMDTrainStep on a 2-process x 4-device global
+    mesh (dp=4 x tp=2) and assert the final loss equals a 1-process
+    8-device run of the same program."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    base_flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+
+    # reference: single process, 8 local devices
+    env1 = dict(env)
+    env1["XLA_FLAGS"] = (base_flags
+                         + " --xla_force_host_platform_device_count=8")
+    ref = subprocess.run([sys.executable, SPMD_WORKER], env=env1,
+                         capture_output=True, text=True, timeout=300)
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    import re
+
+    ref_loss = re.search(r"loss=([0-9.]+)", ref.stdout).group(1)
+
+    # 2 processes x 4 devices each over the launcher
+    env2 = dict(env)
+    env2["XLA_FLAGS"] = (base_flags
+                         + " --xla_force_host_platform_device_count=4")
+    res = subprocess.run(
+        [sys.executable, LAUNCH, "-n", "2",
+         "--coordinator", f"127.0.0.1:{_free_port()}",
+         sys.executable, SPMD_WORKER],
+        env=env2, capture_output=True, text=True, timeout=600)
+    assert res.returncode == 0, (
+        f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
+        f"stderr:\n{res.stderr[-4000:]}")
+    losses = re.findall(r"SPMD_WORKER_OK rank=\d/2 loss=([0-9.]+)",
+                        res.stdout)
+    assert len(losses) == 2, res.stdout[-2000:]
+    assert losses[0] == losses[1], losses  # every rank sees the same loss
+    import numpy as _np
+
+    _np.testing.assert_allclose(float(losses[0]), float(ref_loss),
+                                rtol=1e-5, atol=1e-7)
