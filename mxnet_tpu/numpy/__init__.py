@@ -107,6 +107,7 @@ _FUNCS = [
     # round-3 breadth (auto-skipped when absent from jnp)
     "divmod", "float_power", "frexp", "modf", "logaddexp", "logaddexp2",
     "i0", "sinc", "isin", "intersect1d", "union1d", "setdiff1d",
+    "ix_", "mask_indices",
     "histogram2d", "histogramdd", "bartlett", "blackman", "hamming",
     "hanning", "kaiser", "nanmedian", "nanpercentile", "nanquantile",
     "nancumprod", "select", "piecewise", "rollaxis",
@@ -245,6 +246,26 @@ def fill_diagonal(a, val, wrap=False):
         return _call_recorded(fn, "fill_diagonal", (a, val), {})
     tracked = (val,) if hasattr(val, "_set_data") else ()
     _ag.record_inplace(a, fn, (val,), "np.fill_diagonal",
+                       tracked_extra=tracked)
+    return None
+
+
+def place(arr, mask, vals):
+    """numpy-signature place (jnp defaults to inplace=True which always
+    raises on immutable jax arrays); mutates NDArray inputs like numpy."""
+    from .. import autograd as _ag
+
+    fn = lambda a, m, v: jnp.place(a, m, v, inplace=False)  # noqa: E731
+    # plain numpy inputs carry a .data memoryview that record_inplace's
+    # unwrapping would trip over — normalize to jax arrays up front
+    tracked = (vals,) if hasattr(vals, "_set_data") else ()
+    if not hasattr(mask, "_set_data"):
+        mask = jnp.asarray(mask)
+    if not hasattr(vals, "_set_data"):
+        vals = jnp.asarray(vals)
+    if not hasattr(arr, "_set_data"):
+        return _call_recorded(fn, "place", (arr, mask, vals), {})
+    _ag.record_inplace(arr, fn, (mask, vals), "np.place",
                        tracked_extra=tracked)
     return None
 

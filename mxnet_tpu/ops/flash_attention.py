@@ -44,6 +44,28 @@ def _use_pallas(d):
 # ---------------------------------------------------------------------------
 
 
+def _mask_scores(s, q_pos0, col0, bq, bk, causal, window):
+    """Apply the causal / sliding-window mask to a (bq, bk) score block
+    at rows q_pos0.. and cols col0.. (shared by all four kernels)."""
+    if not (causal or window > 0):
+        return s
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
+    ok = rows >= cols
+    if window > 0:  # sliding window: see only the last W positions
+        ok = ok & (rows - cols < window)
+    return jnp.where(ok, s, _NEG_INF)
+
+
+def _block_active(q_pos0, col0, bq, bk, window):
+    """True when a (q block, kv block) cell intersects the causal
+    triangle (and, for window > 0, the band)."""
+    cond = col0 <= q_pos0 + bq - 1
+    if window > 0:
+        cond = cond & (col0 + bk - 1 >= q_pos0 - window + 1)
+    return cond
+
+
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr, *, scale, causal, bq, bk,
                       kv_blocks, window=0, true_t=0, n_active=0):
@@ -84,13 +106,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         v = v_ref[0]                                     # (bk, d)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window > 0:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + col0
-            ok = rows >= cols
-            if window > 0:  # sliding window: see only the last W positions
-                ok = ok & (rows - cols < window)
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _mask_scores(s, q_pos0, col0, bq, bk, causal, window)
         m_prev = m_scr[:]                                # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -106,9 +122,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # skip blocks entirely above the diagonal, and (windowed) blocks
         # entirely below the band; banded mode additionally guards the
         # clamped negative block indices at the sequence start
-        cond = col0 <= q_pos0 + bq - 1
-        if window > 0:
-            cond = cond & (col0 + bk - 1 >= q_pos0 - window + 1)
+        cond = _block_active(q_pos0, col0, bq, bk, window)
         if n_active:
             cond = cond & (kv_blk >= 0)
 
@@ -258,13 +272,7 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0]                             # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal or window > 0:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q_pos0
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + ki * bk
-            ok = rows >= cols
-            if window > 0:
-                ok = ok & (rows - cols < window)
-            s = jnp.where(ok, s, _NEG_INF)
+        s = _mask_scores(s, q_pos0, ki * bk, bq, bk, causal, window)
         p = jnp.exp(s - lse)                             # (bq, bk) f32
         pc = p.astype(v.dtype)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
@@ -283,11 +291,7 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)
 
     if causal or window > 0:
-        cond = q_pos0 + bq - 1 >= ki * bk
-        if window > 0:
-            cond = cond & (ki * bk + bk - 1 >= q_pos0 - window + 1)
-
-        @pl.when(cond)
+        @pl.when(_block_active(q_pos0, ki * bk, bq, bk, window))
         def _():
             compute()
     else:
@@ -303,21 +307,16 @@ def _flash_bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
-                      window=0):
+def _bwd_preamble(q, k, v, out, lse, g, block_size):
+    """Shared backward setup: GQA head folding, reshapes, and the delta
+    term (sum of do*o per row)."""
     B, H, T, D = q.shape
     KVH = k.shape[1]
     S = k.shape[2]
     group = H // KVH
-    bq = min(bq, T)
-    bk = min(bk, S)
-    if group > 1:
-        # grouped-query (see _pallas_flash_fwd): q-side tensors fold the
-        # group into the sequence axis; dk/dv then accumulate over ALL
-        # of a kv head's query heads through the ordinary qi sweep
-        true_t, t_eff = T, group * T
-    else:
-        true_t, t_eff = 0, T
+    bq = min(block_size, T)
+    bk = min(block_size, S)
+    true_t, t_eff = (T, group * T) if group > 1 else (0, T)
     qr = q.reshape(B * KVH, t_eff, D)
     kr = k.reshape(B * KVH, S, D)
     vr = v.reshape(B * KVH, S, D)
@@ -325,8 +324,18 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
     lse_r = lse.reshape(B * KVH, t_eff, 1)
     delta = jnp.sum(gr.astype(jnp.float32)
                     * out.reshape(B * KVH, t_eff, D).astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (B*KVH, t_eff, 1)
-    q_blocks, kv_blocks = t_eff // bq, S // bk
+                    axis=-1, keepdims=True)
+    return (qr, kr, vr, gr, lse_r, delta, bq, bk, t_eff // bq, S // bk,
+            true_t, t_eff, B * KVH, S, D)
+
+
+def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
+                      window=0):
+    # grouped-query (see _pallas_flash_fwd): q-side tensors fold the
+    # group into the sequence axis; dk/dv then accumulate over ALL of a
+    # kv head's query heads through the ordinary qi sweep
+    (qr, kr, vr, gr, lse_r, delta, bq, bk, q_blocks, kv_blocks, true_t,
+     t_eff, BK, S, D) = _bwd_preamble(q, k, v, out, lse, g, max(bq, bk))
 
     # grid: (batch, kv_block, q_block) — q is the fast (reduction) axis
     q_spec = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
@@ -337,14 +346,14 @@ def _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
                           bq=bq, bk=bk, q_blocks=q_blocks,
                           kv_blocks=kv_blocks, window=window,
                           true_t=true_t),
-        grid=(B * KVH, kv_blocks, q_blocks),
+        grid=(BK, kv_blocks, q_blocks),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=[pl.BlockSpec((1, t_eff, D), lambda b, j, i: (b, 0, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * KVH, t_eff, D), q.dtype),
-                   jax.ShapeDtypeStruct((B * KVH, S, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * KVH, S, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((BK, t_eff, D), q.dtype),
+                   jax.ShapeDtypeStruct((BK, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BK, S, D), v.dtype)],
         scratch_shapes=[pltpu.VMEM((t_eff, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32),
                         pltpu.VMEM((bk, D), jnp.float32)],
@@ -421,19 +430,164 @@ def _flash_fwd_rule(q, k, v, scale, causal, block_size, window=0,
     return out, (q, k, v, out, lse)
 
 
-# the Pallas backward accumulates dq in a full (T, d) VMEM scratch (see
-# _flash_bwd_kernel docstring) — past this T the scratch blows the VMEM
-# budget and the TPU compile helper dies; longer sequences take the jnp
-# blockwise backward instead (the forward stays Pallas at any T)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale, causal, bq, bk,
+                         kv_blocks, window=0, true_t=0):
+    """Split-backward dq kernel: grid (batch, q_block, kv_block) with kv
+    innermost, so each q block's output window is revisited CONSECUTIVELY
+    and dq accumulates in a (bq, d) scratch — no full-(T, d) scratch and
+    no dynamic-slice writes (those serialize Mosaic's pipeline in the
+    fused kernel). s/p are recomputed per cell; the extra matmul is
+    cheaper than the lost overlap."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    q_pos0 = (qi * bq) % true_t if true_t else qi * bq
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask_scores(s, q_pos0, ki * bk, bq, bk, causal, window)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal or window > 0:
+        @pl.when(_block_active(q_pos0, ki * bk, bq, bk, window))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == kv_blocks - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                          bq, bk, q_blocks, window=0, true_t=0):
+    """Split-backward dk/dv kernel: grid (batch, kv_block, q_block) with
+    q innermost; dk/dv accumulate in (bk, d) scratches over the q sweep."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    q_pos0 = (qi * bq) % true_t if true_t else qi * bq
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _mask_scores(s, q_pos0, ki * bk, bq, bk, causal, window)
+        p = jnp.exp(s - lse)
+        pc = p.astype(v.dtype)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            pc, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal or window > 0:
+        @pl.when(_block_active(q_pos0, ki * bk, bq, bk, window))
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == q_blocks - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pallas_flash_bwd_split(q, k, v, out, lse, g, scale, causal, bq=512,
+                            bk=512, window=0):
+    """Two-kernel FA2 backward (dq pass + dkv pass). No full-T scratch,
+    so it scales to any T the forward handles."""
+    (qr, kr, vr, gr, lse_r, delta, bq, bk, q_blocks, kv_blocks, true_t,
+     t_eff, BK, S, D) = _bwd_preamble(q, k, v, out, lse, g, max(bq, bk))
+
+    q_spec_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kv_spec_q = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    row_spec_q = pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, kv_blocks=kv_blocks, window=window,
+                          true_t=true_t),
+        grid=(BK, q_blocks, kv_blocks),
+        in_specs=[q_spec_q, kv_spec_q, kv_spec_q, q_spec_q, row_spec_q,
+                  row_spec_q],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BK, t_eff, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+    )(qr, kr, vr, gr, lse_r, delta)
+
+    q_spec_kv = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    kv_spec_kv = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    row_spec_kv = pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, q_blocks=q_blocks, window=window,
+                          true_t=true_t),
+        grid=(BK, kv_blocks, q_blocks),
+        in_specs=[q_spec_kv, kv_spec_kv, kv_spec_kv, q_spec_kv, row_spec_kv,
+                  row_spec_kv],
+        out_specs=[pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+                   pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((BK, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BK, S, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+    )(qr, kr, vr, gr, lse_r, delta)
+    return (dq.reshape(q.shape), dk.reshape(k.shape), dv.reshape(v.shape))
+
+
+# the FUSED Pallas backward accumulates dq in a full (T, d) VMEM scratch
+# (see _flash_bwd_kernel docstring) — past this T the scratch blows the
+# VMEM budget. Only the FUSED backward (opt-in via MXTPU_FLASH_BWD=fused,
+# see _flash_bwd_rule) is subject to this cap; the default split backward
+# has no full-T scratch and runs at any T the forward handles.
 _PALLAS_BWD_MAX_T = 8192
 
 
 def _flash_bwd_rule(scale, causal, block_size, window, native_gqa, res, g):
     q, k, v, out, lse = res
     group = q.shape[1] // k.shape[1]
+    import os as _os
+
+    _fused = _os.environ.get("MXTPU_FLASH_BWD", "split") == "fused"
     use_native = (native_gqa and group > 1
                   and _pallas_ready(q, k, causal, block_size)
-                  and group * q.shape[2] <= _PALLAS_BWD_MAX_T)
+                  # only the FUSED backward's full-T dq scratch caps the
+                  # flattened length; the split default has no cap
+                  and (not _fused
+                       or group * q.shape[2] <= _PALLAS_BWD_MAX_T))
     if group > 1 and not use_native:
         # default GQA path (also the fallback when the native backward's
         # flattened q exceeds the VMEM cap): run the grad on repeated kv,
@@ -445,10 +599,20 @@ def _flash_bwd_rule(scale, causal, block_size, window, native_gqa, res, g):
         dk = dkf.reshape(B, KVH, group, S, D).sum(axis=2).astype(k.dtype)
         dv = dvf.reshape(B, KVH, group, S, D).sum(axis=2).astype(v.dtype)
         return dq, dk, dv
-    if (_pallas_ready(q, k, causal, block_size)
-            and group * q.shape[2] <= _PALLAS_BWD_MAX_T):
-        return _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal,
-                                 bq=block_size, bk=block_size, window=window)
+    if _pallas_ready(q, k, causal, block_size):
+        fits_fused = group * q.shape[2] <= _PALLAS_BWD_MAX_T
+        if _fused and fits_fused:
+            # kept selectable for A/B: measured 2.44 ms vs split's 1.88
+            # at T=4k D=64 (the full-T dq scratch + dynamic-slice writes
+            # serialize the pipeline), and capped at _PALLAS_BWD_MAX_T
+            return _pallas_flash_bwd(q, k, v, out, lse, g, scale, causal,
+                                     bq=block_size, bk=block_size,
+                                     window=window)
+        # default: split two-kernel backward — no full-T scratch, so it
+        # also extends the Pallas path past _PALLAS_BWD_MAX_T
+        return _pallas_flash_bwd_split(q, k, v, out, lse, g, scale,
+                                       causal, bq=block_size,
+                                       bk=block_size, window=window)
     B, H, T, D = q.shape
     S = k.shape[2]
     bk = min(block_size, S)
@@ -501,8 +665,10 @@ def flash_attention(query, key, value, scale=None, causal=False,
 
     Kernel matmuls keep the INPUT dtype (bf16 on the training path)
     with f32 MXU accumulation — the round-3 kernels upcast to fp32
-    first, which capped them at the ~51 TFLOP/s fp32 MXU ceiling;
-    bf16 operands measure 59-61 TFLOP/s fwd+bwd (T=4k, D=64, v5e).
+    first, which capped them at the ~51 TFLOP/s fp32 MXU ceiling. With
+    bf16 operands + the split two-kernel backward (default, see
+    MXTPU_FLASH_BWD) fwd+bwd measures 81 TFLOP/s / 41% MFU (T=4k,
+    D=64, v5e).
     block_size sweep with the bf16 kernels: 512 -> 45, 1024 -> 49-61
     (run variance) — 1024 stays the default; (bq, bk) clamp to (T, S)
     for short sequences. 1024x1024 bf16 q/k/v/o blocks + f32

@@ -264,29 +264,59 @@ def test_flash_attention_grouped_query_cpu_oracle():
         fa.flash_attention(q, k[:, :1][:, [0, 0, 0]], v[:, :3], causal=True)
 
 
-def test_flash_gqa_native_over_cap_falls_back():
-    """native_gqa=True whose flattened q exceeds the Pallas-backward VMEM
-    cap must route through the repeat-and-fold path, not crash in the
-    unrepeated jnp fallback (review regression)."""
+def test_flash_gqa_native_over_cap_routing():
+    """native_gqa routing around the fused-backward VMEM cap: with the
+    default split backward (no full-T scratch) an over-cap flattened q
+    stays on the NATIVE unrepeated path; with MXTPU_FLASH_BWD=fused the
+    cap forces the repeat-and-fold path whose inner grad then runs the
+    split kernel (r4 behavior; supersedes the r2 jnp-fallback contract)."""
+    import os
+
     import jax.numpy as jnp
 
     from mxnet_tpu.ops import flash_attention as fa
 
-    orig_ready = fa._pallas_ready
-    orig_cap = fa._PALLAS_BWD_MAX_T
+    calls = []
+
+    def fake_split(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
+                   window=0):
+        calls.append(("split", q.shape, k.shape))
+        return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+
+    def fake_fused(q, k, v, out, lse, g, scale, causal, bq=512, bk=512,
+                   window=0):
+        calls.append(("fused", q.shape, k.shape))
+        return (jnp.zeros_like(q), jnp.zeros_like(k), jnp.zeros_like(v))
+
+    orig = (fa._pallas_ready, fa._PALLAS_BWD_MAX_T,
+            fa._pallas_flash_bwd_split, fa._pallas_flash_bwd)
     fa._pallas_ready = lambda q, k, causal, bs: True
-    fa._PALLAS_BWD_MAX_T = 2  # outer group*T=16 and inner T=4 both exceed
+    fa._PALLAS_BWD_MAX_T = 2  # group*T=16 and T=4 both exceed
+    fa._pallas_flash_bwd_split = fake_split
+    fa._pallas_flash_bwd = fake_fused
+    q = jnp.ones((1, 8, 4, 8))
+    k = jnp.ones((1, 2, 4, 8))
+    v = jnp.ones((1, 2, 4, 8))
+    res = (q, k, v, jnp.ones_like(q), jnp.ones((1, 8, 4)), )
     try:
-        q = jnp.ones((1, 8, 4, 8))
-        k = jnp.ones((1, 2, 4, 8))
-        v = jnp.ones((1, 2, 4, 8))
-        out = jnp.ones_like(q)
-        lse = jnp.ones((1, 8, 4))
-        g = jnp.ones_like(q)
+        # default split: native stays unrepeated despite the cap
+        os.environ.pop("MXTPU_FLASH_BWD", None)
         dq, dk, dv = fa._flash_bwd_rule(1.0, True, 4, 0, True,
-                                        (q, k, v, out, lse), g)
-        assert dq.shape == q.shape
-        assert dk.shape == k.shape and dv.shape == v.shape
+                                        (q, k, v, res[3], res[4]),
+                                        jnp.ones_like(q))
+        assert calls == [("split", q.shape, k.shape)], calls
+        assert dq.shape == q.shape and dk.shape == k.shape
+
+        # fused mode: cap forces repeat-and-fold; inner grad goes split
+        calls.clear()
+        os.environ["MXTPU_FLASH_BWD"] = "fused"
+        dq, dk, dv = fa._flash_bwd_rule(1.0, True, 4, 0, True,
+                                        (q, k, v, res[3], res[4]),
+                                        jnp.ones_like(q))
+        assert len(calls) == 1 and calls[0][0] == "split", calls
+        assert calls[0][2] == (1, 8, 4, 8)  # repeated kv heads
+        assert dk.shape == k.shape and dv.shape == v.shape  # folded back
     finally:
-        fa._pallas_ready = orig_ready
-        fa._PALLAS_BWD_MAX_T = orig_cap
+        os.environ.pop("MXTPU_FLASH_BWD", None)
+        (fa._pallas_ready, fa._PALLAS_BWD_MAX_T,
+         fa._pallas_flash_bwd_split, fa._pallas_flash_bwd) = orig
