@@ -399,6 +399,13 @@ def _parse_libsvm(path, dtype):
             _np.asarray(indptr, _np.int64), lab_arr)
 
 
+def _csr_row_slice(vals, idx, indptr, lo, hi):
+    """Slice CSR triplets to rows [lo, hi) with a rebased indptr."""
+    sub_indptr = (indptr[lo:hi + 1] - indptr[lo]).astype(_np.int64)
+    sl = slice(indptr[lo], indptr[hi])
+    return vals[sl], idx[sl], sub_indptr
+
+
 class LibSVMIter(DataIter):
     """LibSVM-format iterator yielding CSR data batches (reference:
     ``src/io/iter_libsvm.cc`` registered via ``DataIteratorReg``).
@@ -414,7 +421,7 @@ class LibSVMIter(DataIter):
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
                  label_shape=None, batch_size=1, round_batch=True,
-                 dtype="float32", **kwargs):
+                 num_parts=1, part_index=0, dtype="float32", **kwargs):
         super().__init__(batch_size)
         from ..ndarray.sparse import CSRNDArray
 
@@ -422,7 +429,21 @@ class LibSVMIter(DataIter):
         if isinstance(data_shape, int):
             data_shape = (data_shape,)
         self._nfeat = int(data_shape[0])
+        if num_parts < 1 or not 0 <= part_index < num_parts:
+            raise MXNetError(
+                f"part_index {part_index} out of range for "
+                f"num_parts {num_parts}")
         vals, idx, indptr, file_labels = _parse_libsvm(data_libsvm, dtype)
+        if num_parts > 1:
+            # distributed sharded read (reference: num_parts/part_index
+            # on iter_libsvm.cc): worker part_index owns one contiguous
+            # row block; the blocks tile the file exactly
+            nrows = len(indptr) - 1
+            lo = part_index * nrows // num_parts
+            hi = (part_index + 1) * nrows // num_parts
+            vals, idx, indptr = _csr_row_slice(vals, idx, indptr, lo, hi)
+            file_labels = file_labels[lo:hi]
+        self._part = (num_parts, part_index)
         if idx.size and int(idx.max()) >= self._nfeat:
             raise MXNetError(
                 f"LibSVMIter: feature index {int(idx.max())} out of range "
@@ -443,6 +464,11 @@ class LibSVMIter(DataIter):
             for r in range(len(lp) - 1):
                 sl = slice(lp[r], lp[r + 1])
                 dense[r, li[sl]] = lv[sl]
+            if num_parts > 1:
+                # the label file shards by the same row blocks as data
+                lrows = len(dense)
+                dense = dense[part_index * lrows // num_parts:
+                              (part_index + 1) * lrows // num_parts]
             self._labels = dense
         else:
             self._labels = file_labels
@@ -458,10 +484,9 @@ class LibSVMIter(DataIter):
         self.provide_label = [DataDesc("softmax_label", lab_shape)]
 
     def _rows(self, lo, hi):
-        sub_indptr = (self._indptr[lo:hi + 1] - self._indptr[lo]).astype(
-            _np.int64)
-        sl = slice(self._indptr[lo], self._indptr[hi])
-        return self._vals[sl], self._idx[sl], sub_indptr, self._labels[lo:hi]
+        vals, idx, indptr = _csr_row_slice(self._vals, self._idx,
+                                           self._indptr, lo, hi)
+        return vals, idx, indptr, self._labels[lo:hi]
 
     def reset(self):
         self._cursor = 0

@@ -125,3 +125,50 @@ def test_trains_linear_model(tmp_path):
             total += float(loss.asnumpy())
         losses.append(total)
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_num_parts_sharding(libsvm_file):
+    """Distributed sharded read (reference num_parts/part_index)."""
+    path, X, y = libsvm_file
+    rows = []
+    for part in range(3):
+        it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,),
+                              batch_size=10, round_batch=False,
+                              num_parts=3, part_index=part)
+        batch = next(iter(it))
+        n = 10 - batch.pad
+        rows.append(batch.data[0].asnumpy()[:n])
+    got = np.concatenate(rows)
+    np.testing.assert_allclose(got, X, rtol=1e-6)  # parts tile the file
+    with pytest.raises(mx.base.MXNetError, match="part_index"):
+        mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,), batch_size=2,
+                         num_parts=2, part_index=5)
+
+
+def test_num_parts_with_label_file(tmp_path):
+    """Sharded read shards the separate label file by the same blocks."""
+    dpath = tmp_path / "d.libsvm"
+    lpath = tmp_path / "l.libsvm"
+    dpath.write_text("".join(f"0 0:{i}.0\n" for i in range(1, 5)))
+    lpath.write_text("".join(f"0 {i % 3}:1.0\n" for i in range(4)))
+    for part in range(2):
+        it = mx.io.LibSVMIter(data_libsvm=str(dpath), data_shape=(1,),
+                              label_libsvm=str(lpath), label_shape=(3,),
+                              batch_size=2, num_parts=2, part_index=part)
+        batch = next(iter(it))
+        np.testing.assert_allclose(
+            batch.data[0].asnumpy()[:, 0],
+            [1.0 + 2 * part, 2.0 + 2 * part])
+        lab = batch.label[0].asnumpy()
+        assert lab.shape == (2, 3)
+        assert lab[0, (2 * part) % 3] == 1.0
+
+
+def test_part_index_validated_even_for_one_part(libsvm_file):
+    path, _, _ = libsvm_file
+    with pytest.raises(mx.base.MXNetError, match="part_index"):
+        mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,), batch_size=2,
+                         num_parts=1, part_index=3)
+    with pytest.raises(mx.base.MXNetError, match="part_index"):
+        mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,), batch_size=2,
+                         num_parts=0)
