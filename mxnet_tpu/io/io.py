@@ -434,20 +434,27 @@ class LibSVMIter(DataIter):
                 f"part_index {part_index} out of range for "
                 f"num_parts {num_parts}")
         vals, idx, indptr, file_labels = _parse_libsvm(data_libsvm, dtype)
-        if num_parts > 1:
-            # distributed sharded read (reference: num_parts/part_index
-            # on iter_libsvm.cc): worker part_index owns one contiguous
-            # row block; the blocks tile the file exactly
-            nrows = len(indptr) - 1
-            lo = part_index * nrows // num_parts
-            hi = (part_index + 1) * nrows // num_parts
-            vals, idx, indptr = _csr_row_slice(vals, idx, indptr, lo, hi)
-            file_labels = file_labels[lo:hi]
-        self._part = (num_parts, part_index)
+        # validate BEFORE sharding so a bad file fails identically on
+        # every worker, not just the one holding the offending row
         if idx.size and int(idx.max()) >= self._nfeat:
             raise MXNetError(
                 f"LibSVMIter: feature index {int(idx.max())} out of range "
                 f"for data_shape {self._nfeat} in {data_libsvm}")
+        total_rows = len(indptr) - 1
+        shard = None
+        if num_parts > 1:
+            # distributed sharded read (reference: num_parts/part_index
+            # on iter_libsvm.cc): worker part_index owns one contiguous
+            # row block; the blocks tile the file exactly. Note: each
+            # worker still PARSES the whole file and keeps its slice —
+            # fine at the scale this pure-Python reader serves (the
+            # reference's byte-range splitter is the optimization to
+            # reach for if startup cost ever matters).
+            lo = part_index * total_rows // num_parts
+            hi = (part_index + 1) * total_rows // num_parts
+            shard = (lo, hi)
+            vals, idx, indptr = _csr_row_slice(vals, idx, indptr, lo, hi)
+            file_labels = file_labels[lo:hi]
         self._vals, self._idx, self._indptr = vals, idx, indptr
         self._nrows = len(indptr) - 1
         if label_libsvm is not None:
@@ -464,11 +471,13 @@ class LibSVMIter(DataIter):
             for r in range(len(lp) - 1):
                 sl = slice(lp[r], lp[r + 1])
                 dense[r, li[sl]] = lv[sl]
-            if num_parts > 1:
-                # the label file shards by the same row blocks as data
-                lrows = len(dense)
-                dense = dense[part_index * lrows // num_parts:
-                              (part_index + 1) * lrows // num_parts]
+            if len(dense) != total_rows:
+                raise MXNetError(
+                    f"LibSVMIter: {total_rows} data rows but "
+                    f"{len(dense)} label rows in {label_libsvm}")
+            if shard is not None:
+                # the label file shards by the SAME row block as data
+                dense = dense[shard[0]:shard[1]]
             self._labels = dense
         else:
             self._labels = file_labels
