@@ -41,9 +41,11 @@ def get_data(data_dir, batch_size):
     def tf(data, label):
         return (mx.nd.array(data).astype("float32") / 255.0, label)
 
-    train = train.transform(tf) if not isinstance(train, gluon.data.ArrayDataset) else train
-    return (gluon.data.DataLoader(train, batch_size, shuffle=True),
-            gluon.data.DataLoader(val, batch_size))
+    # both branches yield uint8 images; scale BOTH train and val so the
+    # validation pass sees the training distribution
+    return (gluon.data.DataLoader(train.transform(tf), batch_size,
+                                  shuffle=True),
+            gluon.data.DataLoader(val.transform(tf), batch_size))
 
 
 def main():

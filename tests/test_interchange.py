@@ -276,3 +276,31 @@ def test_export_imports_with_binary_params(tmp_path):
                                      f"{path}-0000.params")
     got = net2(x).asnumpy()
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_reference_scalar_op_json_imports():
+    """A reference-exported graph containing _mul_scalar/_plus_scalar
+    nodes (the names MXNet's Python operator lowering emits) loads and
+    evaluates (round-4 scalar-family registration)."""
+    import json
+
+    g = {
+        "nodes": [
+            {"op": "null", "name": "a", "inputs": []},
+            {"op": "_mul_scalar", "name": "mul0",
+             "attrs": {"scalar": "3.0"}, "inputs": [[0, 0, 0]]},
+            {"op": "_plus_scalar", "name": "plus0",
+             "attrs": {"scalar": "1.5"}, "inputs": [[1, 0, 0]]},
+            {"op": "Activation", "name": "relu0",
+             "attrs": {"act_type": "relu"}, "inputs": [[2, 0, 0]]},
+        ],
+        "arg_nodes": [0],
+        "node_row_ptr": [0, 1, 2, 3, 4],
+        "heads": [[3, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+    sym = mx.sym.load_json(json.dumps(g))
+    ex = sym.simple_bind(a=(2, 3))
+    x = np.array([[-1.0, 0.5, 2.0], [0.1, -0.2, 0.3]], np.float32)
+    out = ex.forward(a=mx.nd.array(x))[0].asnumpy()
+    np.testing.assert_allclose(out, np.maximum(x * 3 + 1.5, 0), rtol=1e-6)
