@@ -136,8 +136,8 @@ def bench_bert(backend):
                                "64" if backend != "cpu" else "2"))  # (996 vs 967 samples/s)
     seqlen = int(os.environ.get("BENCH_BERT_SEQ",
                                 "128" if backend != "cpu" else "16"))
-    steps = int(os.environ.get("BENCH_BERT_STEPS",
-                               "30" if backend != "cpu" else "2"))
+    steps = int(os.environ.get("BENCH_BERT_STEPS",  # 60: ~4s measured
+                               "60" if backend != "cpu" else "2"))  # window halves relay-jitter scatter vs 30
     dtype = "bfloat16" if backend != "cpu" else "float32"
 
     if backend != "cpu":
