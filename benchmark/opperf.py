@@ -222,6 +222,9 @@ def main():
     ap.add_argument("--json", type=str, default="",
                     help="also write results to this JSON file")
     args = ap.parse_args()
+    if args.mode == "graph" and args.backward:
+        ap.error("graph mode times forward kernels; use --mode eager "
+                 "for tape backward")
 
     import jax
 
@@ -237,6 +240,8 @@ def main():
             continue
         cat, factory, attrs = specs[name]
         if args.category and cat != args.category:
+            if args.ops:
+                print(f"# skip {name}: category {cat} != {args.category}")
             continue
         try:
             if args.mode == "graph" and cat == "random":
@@ -246,10 +251,6 @@ def main():
                       "graph mode")
                 continue
             if args.mode == "graph":
-                if args.backward:
-                    raise NotImplementedError(
-                        "graph mode times forward kernels; use eager for "
-                        "tape backward")
                 per = _time_op_graph(name, factory(), attrs,
                                      chain=args.chain)
             else:

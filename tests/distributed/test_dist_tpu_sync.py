@@ -108,11 +108,12 @@ def test_sharded_checkpoint_multiprocess(tmp_path):
 SPMD_WORKER = os.path.join(ROOT, "tests", "distributed", "spmd_worker.py")
 
 
-def test_spmd_step_multiprocess_multidevice():
+@pytest.mark.parametrize("nprocs,ndev", [(2, 4), (4, 2)])
+def test_spmd_step_multiprocess_multidevice(nprocs, ndev):
     """VERDICT r3 item 8: the real pod topology is N hosts x M local
-    chips. Run the fused SPMDTrainStep on a 2-process x 4-device global
-    mesh (dp=4 x tp=2) and assert the final loss equals a 1-process
-    8-device run of the same program."""
+    chips. Run the fused SPMDTrainStep on an N-process x M-device global
+    mesh (8 devices total, dp=4 x tp=2) and assert the final loss equals
+    a 1-process 8-device run of the same program."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     base_flags = " ".join(
@@ -130,22 +131,22 @@ def test_spmd_step_multiprocess_multidevice():
 
     ref_loss = re.search(r"loss=([0-9.]+)", ref.stdout).group(1)
 
-    # 2 processes x 4 devices each over the launcher
+    # N processes x M devices each over the launcher
     env2 = dict(env)
     env2["XLA_FLAGS"] = (base_flags
-                         + " --xla_force_host_platform_device_count=4")
+                         + f" --xla_force_host_platform_device_count={ndev}")
     res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2",
+        [sys.executable, LAUNCH, "-n", str(nprocs),
          "--coordinator", f"127.0.0.1:{_free_port()}",
          sys.executable, SPMD_WORKER],
         env=env2, capture_output=True, text=True, timeout=600)
     assert res.returncode == 0, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
         f"stderr:\n{res.stderr[-4000:]}")
-    losses = re.findall(r"SPMD_WORKER_OK rank=\d/2 loss=([0-9.]+)",
+    losses = re.findall(rf"SPMD_WORKER_OK rank=\d/{nprocs} loss=([0-9.]+)",
                         res.stdout)
-    assert len(losses) == 2, res.stdout[-2000:]
-    assert losses[0] == losses[1], losses  # every rank sees the same loss
+    assert len(losses) == nprocs, res.stdout[-2000:]
+    assert len(set(losses)) == 1, losses  # every rank sees the same loss
     import numpy as _np
 
     _np.testing.assert_allclose(float(losses[0]), float(ref_loss),
