@@ -141,7 +141,6 @@ class SpectralNorm(HybridBlock):
 
     def forward(self, x):
         from ... import autograd as _ag
-        from ...ndarray import op as F
         from ...ndarray.ndarray import NDArray
 
         import jax.numpy as jnp
@@ -163,10 +162,16 @@ class SpectralNorm(HybridBlock):
         sigma = jnp.sum((u @ wmat) * v)
         with _ag.pause():
             self.u.set_data(NDArray(u))
-        saved = handle._data_
+        # Divide INSIDE the recorded graph: the module consumes a recorded
+        # W/sigma node whose vjp carries the 1/sigma chain factor back to
+        # the raw weight leaf. sigma itself stays detached (standard SN:
+        # u/v treated as constants w.r.t. the weight).
+        sig = NDArray(jnp.maximum(sigma, self._eps).astype(handle.data.dtype))
+        w_scaled = handle / sig
+        saved_map = w_param._data
         try:
-            handle._data_ = (saved / jnp.maximum(sigma, self._eps)) \
-                .astype(saved.dtype)
+            w_param._data = {c: (w_scaled if arr is handle else arr)
+                             for c, arr in saved_map.items()}
             return self.module(x)
         finally:
-            handle._data_ = saved
+            w_param._data = saved_map

@@ -507,15 +507,32 @@ class LibSVMIter(DataIter):
         hi = min(lo + self.batch_size, self._nrows)
         vals, idx, indptr, labels = self._rows(lo, hi)
         pad = self.batch_size - (hi - lo)
-        if pad and self._round_batch and self._nrows >= self.batch_size:
-            # wrap to the epoch start; pad still REPORTS the wrapped row
-            # count (reference num_batch_padd) so consumers can exclude
-            # the duplicates from metrics
-            wvals, widx, windptr, wlabels = self._rows(0, pad)
-            vals = _np.concatenate([vals, wvals])
-            idx = _np.concatenate([idx, widx])
-            indptr = _np.concatenate([indptr, windptr[1:] + indptr[-1]])
-            labels = _np.concatenate([labels, wlabels])
+        if pad and self._round_batch:
+            # wrap to the epoch start, repeating the epoch as many times
+            # as needed when the dataset is shorter than one batch; pad
+            # still REPORTS the wrapped row count (reference
+            # num_batch_padd) so consumers can exclude the duplicates
+            vparts, iparts, pparts, lparts = [vals], [idx], [indptr], [labels]
+            need, base = pad, indptr[-1]
+            epoch = None  # full-epoch chunk, sliced once and reused
+            while need > 0:
+                take = min(need, self._nrows)
+                if take == self._nrows:
+                    if epoch is None:
+                        epoch = self._rows(0, take)
+                    wvals, widx, windptr, wlabels = epoch
+                else:
+                    wvals, widx, windptr, wlabels = self._rows(0, take)
+                vparts.append(wvals)
+                iparts.append(widx)
+                pparts.append(windptr[1:] + base)
+                lparts.append(wlabels)
+                base += windptr[-1]
+                need -= take
+            vals = _np.concatenate(vparts)
+            idx = _np.concatenate(iparts)
+            indptr = _np.concatenate(pparts)
+            labels = _np.concatenate(lparts)
         elif pad:
             # short tail: pad with empty rows
             indptr = _np.concatenate(

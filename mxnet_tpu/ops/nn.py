@@ -282,8 +282,12 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     if training and not use_global_stats:
         red = tuple(i for i in range(data.ndim) if i != axis)
         mean, var = _f32_moments(data, red)  # one read of the conv output
-        new_mean = momentum * moving_mean + (1 - momentum) * mean
-        new_var = momentum * moving_var + (1 - momentum) * var
+        # running stats keep their storage dtype (f32 moments must not
+        # silently promote e.g. float16 aux arrays across a step)
+        new_mean = (momentum * moving_mean + (1 - momentum) * mean) \
+            .astype(moving_mean.dtype)
+        new_var = (momentum * moving_var + (1 - momentum) * var) \
+            .astype(moving_var.dtype)
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var

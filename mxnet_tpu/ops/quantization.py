@@ -215,9 +215,15 @@ def quantized_act(data, min_data, max_data, act_type="relu"):
         out = jnp.tanh(f)
         mn, mx = jnp.asarray(-1.0), jnp.asarray(1.0)
     elif act_type == "softrelu":
-        out = jnp.log1p(jnp.exp(f))
+        import jax
+
+        out = jax.nn.softplus(f)
         mn = jnp.asarray(0.0)
-        mx = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+        # softplus is monotone and softplus(x) > x everywhere, so the
+        # tight output bound is softplus(max_data) — not the raw input
+        # max (clips ~log(2) near 0) and not absmax (over-widens when
+        # |min| > max).
+        mx = jax.nn.softplus(max_data)
     else:
         raise NotImplementedError(
             f"quantized activation '{act_type}' is not supported")

@@ -53,6 +53,21 @@ def test_round_batch_wraps(libsvm_file):
     assert len(list(it)) == 3  # reset replays the epoch
 
 
+def test_round_batch_shorter_than_batch(libsvm_file):
+    """Dataset smaller than one batch: round_batch wraps the epoch
+    repeatedly (modular rows), never zero-pads (r4 advisor finding)."""
+    path, X, y = libsvm_file
+    it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,), batch_size=23,
+                          round_batch=True)
+    batches = list(it)
+    assert len(batches) == 1 and batches[0].pad == 13
+    dense = batches[0].data[0].asnumpy()
+    expect = X[np.arange(23) % 10]
+    np.testing.assert_allclose(dense, expect, rtol=1e-6)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               y[np.arange(23) % 10])
+
+
 def test_pad_mode(libsvm_file):
     path, X, y = libsvm_file
     it = mx.io.LibSVMIter(data_libsvm=path, data_shape=(6,), batch_size=4,

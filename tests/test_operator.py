@@ -146,6 +146,23 @@ def test_batchnorm_inference():
     assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
 
 
+def test_batchnorm_running_stats_keep_dtype():
+    """Training-mode BN must not promote narrow running-stat aux arrays
+    to f32 (the f32 one-pass moments are an internal detail; r4 advisor)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops import nn as nn_ops
+
+    x = jnp.asarray(np.random.rand(2, 3, 4, 4), jnp.float32)
+    g = jnp.ones(3, jnp.float32)
+    b = jnp.zeros(3, jnp.float32)
+    mm = jnp.zeros(3, jnp.float16)
+    mv = jnp.ones(3, jnp.float16)
+    out, nm, nv = nn_ops.batch_norm(x, g, b, mm, mv, training=True,
+                                    fix_gamma=False)
+    assert nm.dtype == jnp.float16 and nv.dtype == jnp.float16
+
+
 def test_layernorm():
     x = np.random.rand(2, 5).astype(np.float32)
     g = np.ones(5, np.float32)

@@ -679,5 +679,13 @@ def test_spectral_norm_layer():
     out.backward()
     g = layer.module.weight.data().grad
     assert g is not None and np.isfinite(g.asnumpy()).all()
+    # analytic check (sigma detached): y = x @ (W/sigma).T, L = sum(y^2)
+    # => dL/dW = (2/sigma) * y.T @ x.  The r4 advisor found the 1/sigma
+    # chain factor silently dropped; this catches any regression.
+    w = layer.module.weight.data().asnumpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    y = x.asnumpy() @ (w / sigma).T
+    expected = (2.0 / sigma) * (y.T @ x.asnumpy())
+    np.testing.assert_allclose(g.asnumpy(), expected, rtol=2e-3, atol=1e-5)
     with pytest.raises(mx.base.MXNetError):
         SpectralNorm(gluon.nn.Flatten())
