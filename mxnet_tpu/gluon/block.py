@@ -323,6 +323,19 @@ class HybridBlock(Block):
         # inside a parent trace via _in_cached_trace()
         Block.hybridize(self, active)
 
+    def optimize_for(self, x=None, backend="tpu_fused_conv_bn",
+                     strict=True, **kwargs):
+        """Apply a backend graph-optimization pass (reference:
+        ``HybridBlock.optimize_for(x, backend='MKLDNN')`` — subgraph
+        conv+BN fusion). The TPU backend switches the interior to NHWC
+        with Pallas conv+BN-stats fusion and RETURNS an adapter keeping
+        the NCHW interface (there is no graph IR to mutate in place;
+        see gluon/nn/tpu_fusion.py). ``x`` (sample input) is accepted
+        for API parity and unused."""
+        from .nn.tpu_fusion import optimize_for as _opt
+
+        return _opt(self, backend=backend, strict=strict)
+
     def infer_shape(self, *args):
         """Set shapes of this block's deferred params from input shapes.
 

@@ -147,6 +147,11 @@ class Activation(HybridBlock):
         return self._act_type
 
     def hybrid_forward(self, F, x):
+        if self._act_type == "relu":
+            from .tpu_fusion import PendingApply
+
+            if isinstance(x, PendingApply) and not x.relu_flag:
+                return x.with_relu()
         return F.Activation(x, act_type=self._act_type)
 
     def __repr__(self):
@@ -202,8 +207,15 @@ class BatchNorm(HybridBlock):
                 init=running_variance_initializer, allow_deferred_init=True,
                 differentiable=False)
 
+    def _effective_axis(self, x):
+        """NHWC fused mode normalises the last axis of 4-D tensors;
+        2-D (post-Dense) inputs keep the configured axis."""
+        if getattr(self, "_tpu_nhwc", False) and x.ndim == 4:
+            return 3
+        return self._axis
+
     def infer_shape(self, x):
-        c = x.shape[self._axis]
+        c = x.shape[self._effective_axis(x)]
         for p in (self.gamma, self.beta, self.running_mean, self.running_var):
             p.shape = (c,)
 
@@ -213,8 +225,19 @@ class BatchNorm(HybridBlock):
         super().cast(dtype)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from .tpu_fusion import StatsArray, fused_batch_norm
+
+        if isinstance(x, StatsArray):
+            k = self._kwargs
+            return fused_batch_norm(
+                x, gamma, beta, running_mean, running_var, k["eps"],
+                k["momentum"], k["fix_gamma"], k["use_global_stats"])
+        kwargs = self._kwargs
+        ax = self._effective_axis(x)
+        if ax != kwargs["axis"]:
+            kwargs = dict(kwargs, axis=ax)
         return F.BatchNorm(x, gamma, beta, running_mean, running_var,
-                           **self._kwargs)
+                           **kwargs)
 
     def __repr__(self):
         in_channels = self.gamma.shape[0] if self.gamma.shape else None
@@ -338,6 +361,10 @@ class Embedding(HybridBlock):
 
 class Flatten(HybridBlock):
     def hybrid_forward(self, F, x):
+        if getattr(self, "_tpu_nchw_flatten", False) and x.ndim == 4:
+            # NHWC fused interior: restore NCHW feature order so the
+            # flattened vector matches NCHW-trained downstream weights
+            x = F.transpose(x, axes=(0, 3, 1, 2))
         return F.flatten(x)
 
     def __repr__(self):

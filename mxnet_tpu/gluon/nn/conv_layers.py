@@ -57,7 +57,8 @@ class _Conv(HybridBlock):
             + tuple(self._kwargs["kernel"])
 
     def infer_shape(self, x):
-        in_c = x.shape[1]
+        layout = self._kwargs.get("layout") or ""
+        in_c = x.shape[-1] if layout.endswith("C") else x.shape[1]
         self._in_channels = in_c
         if self._op_name == "Deconvolution":
             self.weight.shape = (in_c, self._channels // self._kwargs["num_group"]) \
@@ -67,6 +68,10 @@ class _Conv(HybridBlock):
                 + tuple(self._kwargs["kernel"])
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        if getattr(self, "_tpu_fused", False):
+            out = self._fused_forward(F, x, weight, bias)
+            if out is not None:
+                return out
         op = getattr(F, self._op_name)
         if bias is None:
             out = op(x, weight, **self._kwargs)
@@ -75,6 +80,28 @@ class _Conv(HybridBlock):
         if self.act is not None:
             out = self.act(out)
         return out
+
+    def _fused_forward(self, F, x, weight, bias=None):
+        """TPU fused 1x1-conv path (optimize_for backend): NHWC matmul
+        with BN-stats epilogue; consumes a PendingApply input in the
+        kernel prologue. A conv bias stays unapplied on the StatsArray
+        (a batch-stat BN cancels it). See gluon/nn/tpu_fusion.py."""
+        from .tpu_fusion import PendingApply, StatsArray
+
+        if getattr(x, "ndim", 0) != 4:
+            return None
+        b, h, wd, c = x.shape
+        o = self._channels
+        wt = F.transpose(weight.reshape((o, c)))
+        if isinstance(x, PendingApply):
+            raw2 = x.raw.reshape((b * h * wd, c))
+            y2, ysum, yssq = F._contrib_fused_scaled_matmul_stats(
+                raw2, x.scale, x.shift, wt, relu=x.relu_flag)
+        else:
+            x2 = x.reshape((b * h * wd, c))
+            y2, ysum, yssq = F._contrib_fused_matmul_stats(x2, wt)
+        y = y2.reshape((b, h, wd, o))
+        return StatsArray(y, ysum, yssq, b * h * wd, bias=bias)
 
     def __repr__(self):
         return (f"{self.__class__.__name__}({self._in_channels} -> "

@@ -1,0 +1,284 @@
+"""TPU conv+BN fusion pass (``HybridBlock.optimize_for`` backend).
+
+Reference analog: ``HybridBlock.optimize_for(x, backend='MKLDNN')`` —
+the subgraph property that fuses Conv+BN(+ReLU) and switches activation
+layouts to the backend's preferred blocked format
+(``src/operator/subgraph/mkldnn/mkldnn_conv.cc``). The TPU design is
+different in kind: there is no graph IR to rewrite (tracing is direct),
+so fusion happens through *cooperating blocks* exchanging lazily-applied
+tensors:
+
+- ``optimize_for(net, backend='tpu_fused_conv_bn')`` walks the tree,
+  switches every Conv2D/Pooling to NHWC (activations only — parameter
+  layouts are untouched, so checkpoints remain interchangeable), marks
+  eligible 1x1 convolutions, and wraps the net in an adapter that keeps
+  the external NCHW interface.
+- A marked conv emits a :class:`StatsArray` — its raw output plus
+  per-channel (sum, sum-of-squares) accumulated in the Pallas kernel's
+  epilogue (ops/fused_conv_bn.py), so the following BatchNorm never
+  re-reads the tensor to compute batch moments.
+- That BatchNorm returns a :class:`PendingApply` — the raw tensor plus
+  folded per-channel scale/shift. A following marked conv consumes it
+  *unmaterialised* (normalize+relu runs in the matmul prologue); any
+  other consumer transparently materialises on first ``.data`` access
+  through recorded ops, so autograd is oblivious to the laziness.
+"""
+
+from __future__ import annotations
+
+from ... import autograd
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+
+
+class StatsArray(NDArray):
+    """A conv output that carries its own batch statistics.
+
+    ``raw`` is the bias-free matmul output; ``bias`` (or None) is the
+    conv's additive bias, kept UNAPPLIED because a following batch-stat
+    BatchNorm cancels it exactly (it only shifts the recorded running
+    mean). ``bn_stats = (ysum, yssq, count)`` are the kernel-epilogue
+    sums of ``raw``. Mathematically this array is ``raw + bias`` —
+    non-BN consumers materialise that lazily on ``.data`` access."""
+
+    __slots__ = ("raw", "bias", "bn_stats")
+
+    def __init__(self, y: NDArray, ysum: NDArray, yssq: NDArray,
+                 count: int, bias: NDArray = None):
+        super().__init__(y.data[:0], ctx=y.ctx)
+        self._data_ = None
+        self.raw = y
+        self.bias = bias
+        self.bn_stats = (ysum, yssq, count)
+
+    @property
+    def data(self):
+        if self._data_ is None:
+            if self.bias is None:
+                self._data_ = self.raw.data
+                self._ag = self.raw._ag
+            else:
+                c = self.raw.shape[-1]
+                bshape = (1,) * (len(self.raw.shape) - 1) + (c,)
+                out = self.raw + self.bias.astype(self.raw.dtype) \
+                    .reshape(bshape)
+                self._data_ = out.data
+                self._ag = out._ag
+            self._version += 1
+        return self._data_
+
+    @property
+    def shape(self):
+        return self.raw.shape if self._data_ is None \
+            else tuple(self._data_.shape)
+
+    @property
+    def dtype(self):
+        import numpy as _np
+
+        return _np.dtype(self.raw.dtype) if self._data_ is None \
+            else _np.dtype(self._data_.dtype)
+
+
+class PendingApply(NDArray):
+    """A BatchNorm output in deferred form: raw tensor + per-channel
+    scale/shift (+relu) not yet applied. Cooperating convs consume the
+    raw form in their kernel prologue; everyone else materialises
+    lazily (the apply runs as recorded ops, so gradients flow)."""
+
+    __slots__ = ("raw", "scale", "shift", "relu_flag")
+
+    def __init__(self, raw: NDArray, scale: NDArray, shift: NDArray,
+                 relu: bool):
+        # shell: no buffer until materialised
+        super().__init__(raw.data[:0], ctx=raw.ctx)  # placeholder, replaced
+        self._data_ = None
+        self.raw = raw
+        self.scale = scale
+        self.shift = shift
+        self.relu_flag = relu
+
+    def with_relu(self) -> "PendingApply":
+        return PendingApply(self.raw, self.scale, self.shift, True)
+
+    # -- lazy materialisation ------------------------------------------
+    @property
+    def data(self):
+        if self._data_ is None:
+            self._materialize()
+        return self._data_
+
+    @property
+    def shape(self):
+        return self.raw.shape if self._data_ is None \
+            else tuple(self._data_.shape)
+
+    @property
+    def dtype(self):
+        import numpy as _np
+
+        return _np.dtype(self.raw.dtype) if self._data_ is None \
+            else _np.dtype(self._data_.dtype)
+
+    def _materialize(self):
+        from ...ndarray import op as F
+
+        c = self.raw.shape[-1]
+        bshape = (1,) * (len(self.raw.shape) - 1) + (c,)
+        s = self.scale.astype(self.raw.dtype).reshape(bshape)
+        t = self.shift.astype(self.raw.dtype).reshape(bshape)
+        out = self.raw * s + t
+        if self.relu_flag:
+            out = F.relu(out)
+        self._data_ = out.data
+        self._ag = out._ag
+        self._version += 1
+
+
+def fused_batch_norm(x: StatsArray, gamma, beta, running_mean, running_var,
+                     eps, momentum, fix_gamma, use_global_stats):
+    """BatchNorm over a StatsArray: batch moments come from the conv
+    kernel's epilogue sums — no pass over the tensor. Returns a
+    PendingApply; running stats update in place (reference mutates aux
+    states in-kernel, ``src/operator/nn/batch_norm.cc``)."""
+    from ...ndarray import op as F
+
+    ysum, yssq, count = x.bn_stats
+    training = autograd.is_training() and not use_global_stats
+    if training:
+        mean = ysum / float(count)  # of the bias-free raw output
+        var = F.maximum(yssq / float(count) - mean * mean,
+                        F.zeros_like(ysum))
+        with autograd.pause():
+            m = float(momentum)
+            # the recorded running mean is of conv-out = raw + bias
+            rm_new = mean.data if x.bias is None \
+                else mean.data + x.bias.data.astype(mean.dtype)
+            running_mean._set_data(
+                (m * running_mean.data
+                 + (1.0 - m) * rm_new).astype(running_mean.dtype))
+            running_var._set_data(
+                (m * running_var.data
+                 + (1.0 - m) * var.data).astype(running_var.dtype))
+    else:
+        mean, var = running_mean, running_var
+    acc = str(ysum.dtype)  # promote-based stat dtype (f32; f64 on x64)
+    inv = (var.astype(acc) + float(eps)) ** -0.5
+    if fix_gamma:
+        s = inv
+    else:
+        s = gamma.astype(acc) * inv
+    # shift for the BIAS-FREE raw tensor: in training the conv bias
+    # cancels against the batch mean; in eval it survives as (+bias)
+    t = beta.astype(acc) - mean.astype(acc) * s
+    if not training and x.bias is not None:
+        t = t + x.bias.astype(acc) * s
+    return PendingApply(x.raw, s, t, False)
+
+
+# ---------------------------------------------------------------------------
+# the optimize_for pass
+# ---------------------------------------------------------------------------
+
+#: block classes that are layout-agnostic (safe to leave untouched)
+_AGNOSTIC = ()
+
+
+def _agnostic_types():
+    global _AGNOSTIC
+    if not _AGNOSTIC:
+        from . import activations, basic_layers
+
+        types = [basic_layers.Activation, basic_layers.Dense,
+                 basic_layers.Dropout, basic_layers.Flatten,
+                 basic_layers.Lambda, basic_layers.HybridLambda]
+        for name in ("LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish"):
+            if hasattr(activations, name):
+                types.append(getattr(activations, name))
+        _AGNOSTIC = tuple(types)
+    return _AGNOSTIC
+
+
+def convert_block(block):
+    """Switch one block's activation layout to NHWC / mark for fusion.
+    Returns True if handled."""
+    from . import basic_layers, conv_layers
+
+    if isinstance(block, conv_layers.Conv2D):
+        block._kwargs["layout"] = "NHWC"
+        k = block._kwargs
+        block._tpu_fused = (
+            tuple(k["kernel"]) == (1, 1) and tuple(k["stride"]) == (1, 1)
+            and tuple(k["pad"]) == (0, 0) and tuple(k["dilate"]) == (1, 1)
+            and k["num_group"] == 1 and block.act is None)
+        return True
+    if isinstance(block, basic_layers.BatchNorm):
+        # runtime-gated: 4-D inputs normalise the last axis; 2-D
+        # (post-Dense) BNs keep their configured axis
+        block._tpu_nhwc = True
+        return True
+    if isinstance(block, conv_layers._Pooling):
+        block._kwargs["layout"] = "NHWC"
+        return True
+    if isinstance(block, basic_layers.Flatten):
+        # flattening an NHWC interior tensor would permute features vs
+        # the NCHW parameter order; transpose back first (no-op for the
+        # common post-global-pool (b, 1, 1, c) case)
+        block._tpu_nchw_flatten = True
+        return True
+    return False
+
+
+class NCHWAdapter(object):
+    """Callable façade keeping the external NCHW interface of a net whose
+    interior was switched to NHWC. Forward transposes the input once;
+    4-D outputs are transposed back."""
+
+    def __init__(self, net):
+        self._net = net
+
+    def __call__(self, x):
+        from ...ndarray import op as F
+
+        if getattr(x, "ndim", 0) == 4:
+            x = F.transpose(x, axes=(0, 2, 3, 1))
+        out = self._net(x)
+        if isinstance(out, NDArray) and out.ndim == 4:
+            out = F.transpose(out, axes=(0, 3, 1, 2))
+        return out
+
+    def __getattr__(self, name):  # delegate (collect_params, cast, ...)
+        return getattr(self._net, name)
+
+
+def optimize_for(net, backend="tpu_fused_conv_bn", strict=True):
+    """Walk ``net`` converting conv/BN/pooling blocks to the NHWC fused
+    pipeline; returns an adapter preserving the NCHW interface.
+
+    ``strict=False`` skips unknown block types instead of raising (the
+    reference backend falls back to the default graph the same way)."""
+    if backend != "tpu_fused_conv_bn":
+        raise MXNetError(f"unknown optimize_for backend '{backend}'")
+
+    seen = set()
+
+    def walk(b):
+        if id(b) in seen:
+            return
+        seen.add(id(b))
+        handled = convert_block(b)
+        if not handled and strict and b._reg_params \
+                and not isinstance(b, _agnostic_types()):
+            # a block with its OWN parameters that we don't understand is
+            # likely layout-sensitive (InstanceNorm axis=1, Conv3D, ...):
+            # refuse rather than silently compute the wrong thing — the
+            # reference backend falls back the same way
+            raise MXNetError(
+                "optimize_for(tpu_fused_conv_bn): unsupported "
+                f"parameterised block {type(b).__name__}; pass "
+                "strict=False to skip it (at your own risk)")
+        for child in b._children.values():
+            walk(child)
+
+    walk(net)
+    return NCHWAdapter(net)

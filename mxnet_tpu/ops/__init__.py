@@ -9,3 +9,4 @@ from . import math, shape_ops, nn, ctc, contrib, flash_attention  # noqa: F401
 from . import linalg, tensor_extra, nn_extra, detection  # noqa: F401
 from . import optimizer_ops, random_ops, misc_ops, quantization  # noqa: F401
 from . import image_ops, contrib_extra, graph_ops  # noqa: F401
+from . import fused_conv_bn  # noqa: F401

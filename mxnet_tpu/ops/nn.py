@@ -47,7 +47,14 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
     stride = stride or (1,) * nd
     dilate = dilate or (1,) * nd
     pad = pad or (0,) * nd
-    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
+    channels_last = bool(layout) and layout.endswith("C")
+    if channels_last:
+        # weights stay OIHW (the param layout never changes — only the
+        # activation layout; used by the TPU fused-conv-BN pipeline)
+        spec = (layout, "OI" + layout[1:-1], layout)
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, spec)
+    else:
+        dn = lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_DN[nd])
     y = lax.conv_general_dilated(
         data,
         weight,
@@ -58,7 +65,9 @@ def convolution(data, weight, bias=None, kernel=(), stride=(), dilate=(), pad=()
         feature_group_count=num_group,
     )
     if bias is not None and not no_bias:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        shape = (1,) + (1,) * nd + (-1,) if channels_last \
+            else (1, -1) + (1,) * nd
+        y = y + bias.reshape(shape)
     return y
 
 
@@ -107,25 +116,31 @@ def pooling(data, kernel=(), pool_type="max", global_pool=False, stride=(),
             pad=(), pooling_convention="valid", count_include_pad=True,
             cudnn_off=False, p_value=2, layout=None):
     nd = data.ndim - 2
+    channels_last = bool(layout) and layout.endswith("C")
+    sp0 = 1 if channels_last else 2  # first spatial axis
     if global_pool:
-        kernel = data.shape[2:]
+        kernel = data.shape[sp0:sp0 + nd]
         stride = (1,) * nd
         pad = (0,) * nd
     stride = stride or (1,) * nd
     pad = pad or (0,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    def _expand(sp, fill):
+        sp = tuple(sp)
+        return (fill,) + sp + (fill,) if channels_last else (fill, fill) + sp
+
+    window = _expand(kernel, 1)
+    strides = _expand(stride, 1)
+    pads = _expand([(p, p) for p in pad], (0, 0))
     if pooling_convention == "full":
         # ceil-mode: add extra right-padding so the last window fits
         extra = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             rem = (size - kernel[i]) % stride[i]
             extra.append((stride[i] - rem) % stride[i] if size > kernel[i] else 0)
-        pads = ((0, 0), (0, 0)) + tuple(
-            (pad[i], pad[i] + extra[i]) for i in range(nd)
-        )
+        pads = _expand([(pad[i], pad[i] + extra[i]) for i in range(nd)],
+                       (0, 0))
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return lax.reduce_window(data, init, lax.max, window, strides, pads)

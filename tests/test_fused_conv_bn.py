@@ -1,0 +1,253 @@
+"""Fused 1x1-conv (matmul) + BN-stats op tests (CPU: reference path +
+interpret-mode Pallas parity; the on-chip path is covered by
+tests_tpu/test_fused_conv_bn_tpu.py).
+
+Reference semantics: ``src/operator/nn/batch_norm.cc`` +
+``src/operator/subgraph/mkldnn/mkldnn_conv.cc`` (conv+BN subgraph
+fusion); the TPU design is original — see ops/fused_conv_bn.py."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops import fused_conv_bn as F
+
+
+@pytest.fixture
+def data():
+    rng = np.random.RandomState(7)
+    M, K, N = 128, 64, 32
+    return {
+        "x": jnp.asarray(rng.randn(M, K), jnp.float32),
+        "w": jnp.asarray(rng.randn(K, N) * 0.1, jnp.float32),
+        "s": jnp.asarray(rng.rand(K) + 0.5, jnp.float32),
+        "t": jnp.asarray(rng.randn(K) * 0.1, jnp.float32),
+        "cd": (jnp.asarray(rng.randn(M, N), jnp.float32),
+               jnp.asarray(rng.randn(N), jnp.float32),
+               jnp.asarray(rng.randn(N) * 0.01, jnp.float32)),
+    }
+
+
+def test_fwd_interpret_matches_reference(data):
+    for scale, bias, relu in [(None, None, False),
+                              (data["s"], data["t"], True),
+                              (data["s"], data["t"], False)]:
+        y1, s1, q1 = F._fused_fwd_pallas(data["x"], data["w"], scale, bias,
+                                         relu=relu, interpret=True)
+        y2, s2, q2 = F._fused_fwd_reference(data["x"], data["w"], scale,
+                                            bias, relu=relu)
+        np.testing.assert_allclose(np.asarray(y1, np.float32),
+                                   np.asarray(y2, np.float32), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(q1, q2, rtol=1e-5, atol=1e-5)
+
+
+def test_bwd_interpret_matches_reference(data):
+    x, w, s, t = data["x"], data["w"], data["s"], data["t"]
+    dy, dsum, dssq = data["cd"]
+    for scale, bias, relu in [(None, None, False), (s, t, True)]:
+        y, _, _ = F._fused_fwd_reference(x, w, scale, bias, relu=relu)
+        r1 = F._fused_bwd_pallas(x, w, y, scale, bias, dy, dsum, dssq,
+                                 relu=relu, interpret=True)
+        r2 = F._fused_bwd_reference(x, w, y, scale, bias, dy, dsum, dssq,
+                                    relu=relu)
+        for a, b in zip(r1, r2):
+            if b is None:
+                continue
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32).reshape(np.asarray(b).shape),
+                np.asarray(b, np.float32), rtol=2e-5, atol=1e-5)
+
+
+def test_custom_vjp_matches_autodiff(data):
+    """The hand-derived backward (stat cotangents as per-channel scalars,
+    dY = dy + dsum + 2*y*dssq) must equal jax.grad of the plain form."""
+    x, w, s, t = data["x"], data["w"], data["s"], data["t"]
+    cd = data["cd"]
+
+    def loss_custom(x, w):
+        y, a, b = F.matmul_stats(x, w)
+        return jnp.sum(y * cd[0]) + jnp.sum(a * cd[1]) + jnp.sum(b * cd[2])
+
+    def loss_plain(x, w):
+        y, a, b = F._fused_fwd_reference(x, w, None, None)
+        return jnp.sum(y * cd[0]) + jnp.sum(a * cd[1]) + jnp.sum(b * cd[2])
+
+    g1 = jax.grad(loss_custom, (0, 1))(x, w)
+    g2 = jax.grad(loss_plain, (0, 1))(x, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def loss_custom2(x, s_, t_, w):
+        y, a, b = F.scaled_matmul_stats(x, s_, t_, w, True)
+        return jnp.sum(y * cd[0]) + jnp.sum(a * cd[1]) + jnp.sum(b * cd[2])
+
+    def loss_plain2(x, s_, t_, w):
+        y, a, b = F._fused_fwd_reference(x, w, s_, t_, relu=True)
+        return jnp.sum(y * cd[0]) + jnp.sum(a * cd[1]) + jnp.sum(b * cd[2])
+
+    g1 = jax.grad(loss_custom2, (0, 1, 2, 3))(x, s, t, w)
+    g2 = jax.grad(loss_plain2, (0, 1, 2, 3))(x, s, t, w)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def _build_r50(pfx, x32):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=10, prefix=pfx)
+    net.initialize(init=mx.initializer.Xavier())
+    net(x32)
+    return net
+
+
+def _suffix_params(net):
+    return {k.split("_", 1)[1]: v for k, v in net.collect_params().items()}
+
+
+def test_resnet50_fused_parity_f32():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.rand(8, 3, 32, 32).astype(np.float32))
+    n1, n2 = _build_r50("fa_", x), _build_r50("fb_", x)
+    p1, p2 = _suffix_params(n1), _suffix_params(n2)
+    for k in p1:
+        p2[k].set_data(p1[k].data())
+    fused = n2.optimize_for(backend="tpu_fused_conv_bn")
+
+    cnt = [0]
+
+    def walk(b):
+        cnt[0] += bool(getattr(b, "_tpu_fused", False))
+        for c in b._children.values():
+            walk(c)
+
+    walk(n2)
+    assert cnt[0] >= 30, cnt[0]  # every stride-1 1x1 conv marked
+
+    # eval parity is tight (running stats, no batch-stat conditioning)
+    y1, y2 = n1(x), fused(x)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), atol=1e-5)
+
+    # one training step: loss close, running stats track
+    lab = mx.nd.array(rng.randint(0, 10, (8,)).astype(np.float32))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for net in (n1, n2):
+        for p in net.collect_params().values():
+            if p.grad_req != "null":
+                p.data().attach_grad()
+    with autograd.record():
+        l1 = loss_fn(n1(x), lab).mean()
+    l1.backward()
+    with autograd.record():
+        l2 = loss_fn(fused(x), lab).mean()
+    l2.backward()
+    assert abs(float(l1.asnumpy()) - float(l2.asnumpy())) < 5e-3
+    for k in p1:
+        if "running" in k:
+            np.testing.assert_allclose(p1[k].data().asnumpy(),
+                                       p2[k].data().asnumpy(), atol=5e-3)
+    # early-stage weight grads match before BN conditioning compounds
+    for k in p1:
+        if p1[k].grad_req == "null" or "bias" in k:
+            continue
+        if "stage1" in k or k.startswith("conv"):
+            g1 = p1[k].data().grad.asnumpy()
+            g2 = p2[k].data().grad.asnumpy()
+            rel = np.abs(g1 - g2).max() / (np.abs(g1).max() + 1e-8)
+            # late-stage BNs run at var ~ eps on these tiny shapes and
+            # chaotically amplify rounding (see the x64 test for the
+            # exact-parity proof); early stages stay well-conditioned
+            assert rel < 0.1, (k, rel)
+
+
+def test_resnet50_fused_parity_x64_subprocess():
+    """Run the float64 semantic-parity check in a subprocess (x64 flag
+    must be set before backend init). Verifies loss diff < 1e-9 and all
+    weight grads < 1e-8 relative — the fused path is exact, not merely
+    close."""
+    import subprocess
+    import sys as _sys
+
+    code = r'''
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+rng = np.random.RandomState(0)
+# 64x64 keeps the last stage at 2x2 spatial: batch-stat variance stays
+# well above eps, so BN does not chaotically amplify reassociation noise
+# (at 32x32 / spatial 1x1, var ~ eps amplifies 1e-12 to 1e-5 even in f64)
+x32 = mx.nd.array(rng.rand(2, 3, 64, 64).astype(np.float32))
+x = x32.astype("float64")
+def build(pfx):
+    net = vision.resnet50_v1(classes=10, prefix=pfx)
+    net.initialize(init=mx.initializer.Xavier())
+    net(x32)
+    net.cast("float64")
+    return net
+n1, n2 = build("xa_"), build("xb_")
+p1 = {k.split("_",1)[1]: v for k,v in n1.collect_params().items()}
+p2 = {k.split("_",1)[1]: v for k,v in n2.collect_params().items()}
+for k in p1: p2[k].set_data(p1[k].data())
+fused = n2.optimize_for(backend="tpu_fused_conv_bn")
+lab = mx.nd.array(rng.randint(0, 10, (2,)).astype(np.float64))
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+for net in (n1, n2):
+    for p in net.collect_params().values():
+        if p.grad_req != "null": p.data().attach_grad()
+with autograd.record():
+    l1 = loss_fn(n1(x), lab).mean()
+l1.backward()
+with autograd.record():
+    l2 = loss_fn(fused(x), lab).mean()
+l2.backward()
+assert abs(float(l1.asnumpy()) - float(l2.asnumpy())) < 1e-9
+for k in p1:
+    if p1[k].grad_req == "null" or "bias" in k: continue
+    g1 = p1[k].data().grad.asnumpy(); g2 = p2[k].data().grad.asnumpy()
+    rel = np.abs(g1-g2).max() / (np.abs(g1).max() + 1e-12)
+    assert rel < 1e-8, (k, rel)
+print("X64-PARITY-OK")
+'''
+    env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([_sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=600)
+    assert "X64-PARITY-OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_bn_equivalence_through_stats():
+    """Composing matmul_stats with scalar BN math reproduces the
+    framework's batch_norm (training mode) bit-for-bit-ish."""
+    from mxnet_tpu.ops import nn as nn_ops
+
+    rng = np.random.RandomState(3)
+    M, K, N = 64, 16, 8
+    x = jnp.asarray(rng.randn(M, K), jnp.float32)
+    w = jnp.asarray(rng.randn(K, N) * 0.3, jnp.float32)
+    g = jnp.asarray(rng.rand(N) + 0.5, jnp.float32)
+    b = jnp.asarray(rng.randn(N), jnp.float32)
+
+    y, ysum, yssq = F.matmul_stats(x, w)
+    mean = ysum / M
+    var = jnp.maximum(yssq / M - mean * mean, 0.0)
+    inv = jax.lax.rsqrt(var + 1e-3)
+    out_fused = (y - mean) * inv * g + b
+
+    y2 = jnp.dot(x, w)
+    mm = jnp.zeros(N)
+    mv = jnp.ones(N)
+    out_bn, _, _ = nn_ops.batch_norm(
+        y2.reshape(M, N, 1, 1), g, b, mm, mv, training=True,
+        fix_gamma=False, axis=1)
+    np.testing.assert_allclose(out_fused, out_bn.reshape(M, N),
+                               rtol=1e-4, atol=1e-5)
