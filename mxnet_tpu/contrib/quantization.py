@@ -26,7 +26,10 @@ from ..ops import quantization as qops
 
 
 def _walk(block):
-    """Flatten a block tree into a layer list (supported layers only)."""
+    """Flatten a block tree into a layer list (supported layers only).
+    Zoo-style feature-extractor nets (``.features`` + ``.output``, the
+    model_zoo convention) open into their two sub-trees; residual blocks
+    stay leaves (planned as composite stages)."""
     from ..gluon.nn import HybridSequential, Sequential
 
     if isinstance(block, (HybridSequential, Sequential)):
@@ -34,6 +37,9 @@ def _walk(block):
         for child in block._children.values():
             out.extend(_walk(child))
         return out
+    if hasattr(block, "features") and hasattr(block, "output") \
+            and not hasattr(block, "body"):
+        return _walk(block.features) + _walk(block.output)
     return [block]
 
 
@@ -61,6 +67,20 @@ def _float_conv(raw, w, b, kw):
         no_bias=b is None, **kw).data
 
 
+def _float_bn(raw, layer):
+    """Float inference BN from running stats (shared by calibration and
+    the excluded-stage execution path)."""
+    g = layer.gamma.data().data
+    bt = layer.beta.data().data
+    mean = layer.running_mean.data().data
+    var = layer.running_var.data().data
+    eps = layer._kwargs.get("eps", 1e-5)
+    shape = (1, -1) + (1,) * (raw.ndim - 2)
+    inv = g / jnp.sqrt(var + eps)
+    return (raw - mean.reshape(shape)) * inv.reshape(shape) \
+        + bt.reshape(shape)
+
+
 def _float_dense(raw, w, b, flatten):
     from ..ndarray import op as ndop
 
@@ -71,19 +91,26 @@ def _float_dense(raw, w, b, flatten):
 
 
 class QuantizedNet:
-    """Calibrated int8 inference pipeline over a layer list."""
+    """Calibrated int8 inference pipeline over a stage tree (residual
+    stages carry body/shortcut sub-pipelines; their int8 add keeps the
+    skip connection quantized end-to-end)."""
 
     def __init__(self, stages):
-        self._stages = stages  # list of (kind, payload)
+        self._stages = stages
 
     def __call__(self, x):
         raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+        raw, qrange = self._run(self._stages, raw, None)
+        if qrange is not None:
+            raw = qops.dequantize(raw, *qrange)
+        return NDArray(raw)
+
+    def _run(self, stages, raw, qrange):
         # (mn, mx) != None marks raw as LIVE int8 with that float range:
-        # relu/pool/flatten then run their quantized_* ops directly and
-        # the next conv/dense consumes the int8 without a re-quantize —
-        # activations stay int8 end-to-end between calibrated stages
-        qrange = None
-        for kind, p in self._stages:
+        # relu/pool/flatten/bn/residual-add then run their quantized_*
+        # ops directly and the next conv/dense consumes the int8 without
+        # a re-quantize — activations stay int8 between stages
+        for kind, p in stages:
             if kind == "float":
                 if qrange is not None:
                     raw, qrange = qops.dequantize(raw, *qrange), None
@@ -132,11 +159,36 @@ class QuantizedNet:
                     raw = p["fn"](raw)
             elif kind == "flatten":
                 raw = raw.reshape(raw.shape[0], -1)
+            elif kind == "bn":
+                if qrange is not None:
+                    raw, lo, hi = qops.quantized_batch_norm(
+                        raw, p["gamma"], p["beta"], p["mean"], p["var"],
+                        qrange[0], qrange[1], eps=p["eps"])
+                    qrange = (lo, hi)
+                else:
+                    raw = _float_bn(raw, p["layer"])
+            elif kind == "residual":
+                a, qa = self._run(p["body"], raw, qrange)
+                if p["shortcut"] is not None:
+                    b, qb = self._run(p["shortcut"], raw, qrange)
+                else:
+                    b, qb = raw, qrange
+                if qa is not None and qb is not None:
+                    cal = p.get("out_range")
+                    raw, lo, hi = qops.quantized_elemwise_add(
+                        a, b, qa[0], qa[1], qb[0], qb[1],
+                        min_calib_range=None if cal is None else cal[0],
+                        max_calib_range=None if cal is None else cal[1])
+                    raw, lo, hi = qops.quantized_act(raw, lo, hi,
+                                                     act_type="relu")
+                    qrange = (lo, hi)
+                else:
+                    fa = qops.dequantize(a, *qa) if qa is not None else a
+                    fb = qops.dequantize(b, *qb) if qb is not None else b
+                    raw, qrange = jnp.maximum(fa + fb, 0.0), None
             else:  # pragma: no cover
                 raise MXNetError(f"unknown stage {kind}")
-        if qrange is not None:
-            raw = qops.dequantize(raw, *qrange)
-        return NDArray(raw)
+        return raw, qrange
 
 
 def _quantize_weights(w, b):
@@ -152,29 +204,34 @@ def _quantize_weights(w, b):
     return payload
 
 
-def quantize_net(net, calib_data=None, quantized_dtype="int8",
-                 calib_mode="naive", exclude_layers=()):
-    """Post-training-quantize a supported Gluon block.
+def _is_residual_v1(layer):
+    """Zoo V1 residual block (or a subclass): the planner compiles it as
+    relu(body(x) + downsample(x)), so only blocks KNOWN to have that
+    forward qualify — a structurally similar custom block still raises
+    (this module refuses loudly rather than silently changing math)."""
+    from ..gluon.model_zoo.vision.resnet import BasicBlockV1, BottleneckV1
 
-    calib_data: iterable of input batches (NDArray or array-like) run
-    through the fp32 net to record per-layer activation ranges.
-    """
-    if quantized_dtype != "int8":
-        raise MXNetError("only int8 quantization is implemented "
-                         "(reference default); use amp for bf16")
-    if calib_mode not in ("naive", "entropy"):
-        raise MXNetError("calib_mode must be 'naive' (min/max) or "
-                         "'entropy' (KL-minimizing threshold, reference "
-                         "calibrate.cc)")
-    layers = _walk(net)
+    return isinstance(layer, (BasicBlockV1, BottleneckV1))
 
-    # --- plan stages, folding BatchNorm into the preceding conv/dense ----
-    plan = []  # (kind, layer, extras)
+
+def _plan_layers(layers, exclude_layers):
+    """Plan nodes: [kind, layer, extras, meta] — meta collects
+    calibration ranges in place (the plan is a tree, so index keys
+    don't work)."""
+    plan = []
     i = 0
     while i < len(layers):
         layer = layers[i]
         nxt = layers[i + 1] if i + 1 < len(layers) else None
-        if isinstance(layer, nn.Conv2D) or isinstance(layer, nn.Dense):
+        if _is_residual_v1(layer):
+            sub = {
+                "body": _plan_layers(_walk(layer.body), exclude_layers),
+                "shortcut": (_plan_layers(_walk(layer.downsample),
+                                          exclude_layers)
+                             if layer.downsample else None),
+            }
+            plan.append(["residual", layer, sub, {}])
+        elif isinstance(layer, nn.Conv2D) or isinstance(layer, nn.Dense):
             w = layer.weight.data().asnumpy().astype(np.float32)
             b = layer.bias.data().asnumpy().astype(np.float32) \
                 if layer.bias is not None else None
@@ -192,107 +249,114 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
                 nxt = layers[i + 1] if i + 1 < len(layers) else None
             kind = "conv" if isinstance(layer, nn.Conv2D) else "dense"
             excluded = layer.name in exclude_layers
-            plan.append((("float_" + kind) if excluded else kind,
-                         layer, (w, b)))
+            plan.append([("float_" + kind) if excluded else kind,
+                         layer, (w, b), {}])
             if layer.act is not None:
                 if layer.act._act_type != "relu":
                     raise MXNetError(
                         f"only relu activations quantize; got "
                         f"{layer.act._act_type}")
-                plan.append(("relu", None, None))
+                plan.append(["relu", None, None, {}])
         elif isinstance(layer, nn.Activation):
             if layer._act_type != "relu":
                 raise MXNetError(
                     f"only relu activations quantize; got "
                     f"{layer._act_type}")
-            plan.append(("relu", None, None))
+            plan.append(["relu", None, None, {}])
         elif isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D,
                                 nn.GlobalAvgPool2D)):
-            plan.append(("pool", layer, None))
+            plan.append(["pool", layer, None, {}])
         elif isinstance(layer, nn.Flatten):
-            plan.append(("flatten", None, None))
+            plan.append(["flatten", None, None, {}])
         elif isinstance(layer, nn.BatchNorm):
-            raise MXNetError("BatchNorm without a preceding conv/dense "
-                             "cannot be folded — unsupported topology")
+            # standalone BN (no conv to fold into): runs as
+            # quantized_batch_norm on live int8 inputs
+            plan.append(["bn", layer, None, {}])
         elif isinstance(layer, nn.Dropout):
             pass  # identity at inference
         else:
             raise MXNetError(
                 f"quantize_net: unsupported layer {type(layer).__name__}")
         i += 1
+    return plan
 
-    # --- calibration: record input AND output ranges of quantizable
-    # stages (outputs feed the requantize that keeps activations int8
-    # through relu/pool chains) ------------------------------------------
-    ranges = {}  # stage index -> [min, max] of the stage INPUT
-    out_ranges = {}  # stage index -> [min, max] of the stage OUTPUT
-    samples = {}  # stage index -> list of |x| samples (entropy mode)
-    if calib_data is None:
-        raise MXNetError("calib_data is required for calibration")
-    from ..ndarray import op as ndop
 
-    for batch in calib_data:
-        raw = batch.data if isinstance(batch, NDArray) else jnp.asarray(batch)
-        for si, (kind, layer, extras) in enumerate(plan):
-            if kind in ("conv", "dense", "float_conv", "float_dense"):
-                if not kind.startswith("float_"):
-                    lo = float(jnp.min(raw))
-                    hi = float(jnp.max(raw))
-                    if si in ranges:
-                        ranges[si][0] = min(ranges[si][0], lo)
-                        ranges[si][1] = max(ranges[si][1], hi)
-                    else:
-                        ranges[si] = [lo, hi]
-                    if calib_mode == "entropy":
-                        flat = np.abs(np.asarray(raw, np.float32)).ravel()
-                        if flat.size > 16384:  # bound calibration memory
-                            flat = flat[:: flat.size // 16384 + 1]
-                        samples.setdefault(si, []).append(flat)
-                kind = kind.replace("float_", "")
-                # run the FOLDED float math (the BN is gone from the plan,
-                # so downstream ranges must see the folded activations)
-                w, b = extras
-                if kind == "conv":
-                    kw = {k: v for k, v in layer._kwargs.items()
-                          if k not in ("no_bias", "layout")}
-                    raw = _float_conv(raw, w, b, kw)
-                else:
-                    raw = _float_dense(raw, w, b, layer._flatten)
-                olo, ohi = float(jnp.min(raw)), float(jnp.max(raw))
-                if si in out_ranges:
-                    out_ranges[si][0] = min(out_ranges[si][0], olo)
-                    out_ranges[si][1] = max(out_ranges[si][1], ohi)
-                else:
-                    out_ranges[si] = [olo, ohi]
-            elif kind == "relu":
-                raw = jnp.maximum(raw, 0.0)
-            elif kind == "pool":
-                raw = layer(NDArray(raw)).data
-            elif kind == "flatten":
-                raw = raw.reshape(raw.shape[0], -1)
+def _merge_range(meta, key, lo, hi):
+    if key in meta:
+        meta[key][0] = min(meta[key][0], lo)
+        meta[key][1] = max(meta[key][1], hi)
+    else:
+        meta[key] = [lo, hi]
 
-    if calib_mode == "entropy":
-        # KL-minimizing symmetric thresholds (reference calibrate.cc via
-        # the _contrib_calibrate_entropy op)
-        from ..ops.registry import get as _get_op
 
-        _calib = _get_op("calibrate_entropy").fn
-        for si, chunks in samples.items():
-            vals = np.concatenate(chunks)
-            amax = float(vals.max()) or 1.0
-            # reference calibrate.cc uses 8001 bins over millions of
-            # activations; with few samples that histogram is so sparse
-            # the KL estimate is noise — scale bins to the sample count
-            bins = 8001 if vals.size >= 100_000 else \
-                2001 if vals.size >= 10_000 else 401
-            hist, edges = np.histogram(
-                np.concatenate([-vals, vals]), bins=bins, range=(-amax, amax))
-            thr = float(_calib(jnp.asarray(hist), jnp.asarray(edges))[0][0])
-            ranges[si] = [-thr, thr]
+def _calib_run(plan, raw, calib_mode):
+    """Run one batch through the float (BN-folded) plan, recording
+    per-stage input/output ranges into each node's meta."""
+    for kind, layer, extras, meta in plan:
+        if kind in ("conv", "dense", "float_conv", "float_dense"):
+            if not kind.startswith("float_"):
+                _merge_range(meta, "in", float(jnp.min(raw)),
+                             float(jnp.max(raw)))
+                if calib_mode == "entropy":
+                    flat = np.abs(np.asarray(raw, np.float32)).ravel()
+                    if flat.size > 16384:  # bound calibration memory
+                        flat = flat[:: flat.size // 16384 + 1]
+                    meta.setdefault("samples", []).append(flat)
+            w, b = extras
+            # run the FOLDED float math (the BN is gone from the plan,
+            # so downstream ranges must see the folded activations)
+            if kind.endswith("conv"):
+                kw = {k: v for k, v in layer._kwargs.items()
+                      if k not in ("no_bias", "layout")}
+                raw = _float_conv(raw, w, b, kw)
+            else:
+                raw = _float_dense(raw, w, b, layer._flatten)
+            _merge_range(meta, "out", float(jnp.min(raw)),
+                         float(jnp.max(raw)))
+        elif kind == "relu":
+            raw = jnp.maximum(raw, 0.0)
+        elif kind == "pool":
+            raw = layer(NDArray(raw)).data
+        elif kind == "flatten":
+            raw = raw.reshape(raw.shape[0], -1)
+        elif kind == "bn":
+            raw = _float_bn(raw, layer)
+        elif kind == "residual":
+            a = _calib_run(extras["body"], raw, calib_mode)
+            b = _calib_run(extras["shortcut"], raw, calib_mode) \
+                if extras["shortcut"] else raw
+            s = a + b
+            _merge_range(meta, "out", float(jnp.min(s)),
+                         float(jnp.max(s)))
+            raw = jnp.maximum(s, 0.0)
+    return raw
 
-    # --- build the quantized pipeline ------------------------------------
+
+def _entropy_pass(plan, _calib):
+    for kind, layer, extras, meta in plan:
+        if kind == "residual":
+            _entropy_pass(extras["body"], _calib)
+            if extras["shortcut"]:
+                _entropy_pass(extras["shortcut"], _calib)
+        chunks = meta.pop("samples", None)
+        if not chunks:
+            continue
+        vals = np.concatenate(chunks)
+        amax = float(vals.max()) or 1.0
+        # reference calibrate.cc uses 8001 bins over millions of
+        # activations; with few samples that histogram is so sparse
+        # the KL estimate is noise — scale bins to the sample count
+        bins = 8001 if vals.size >= 100_000 else \
+            2001 if vals.size >= 10_000 else 401
+        hist, edges = np.histogram(
+            np.concatenate([-vals, vals]), bins=bins, range=(-amax, amax))
+        thr = float(_calib(jnp.asarray(hist), jnp.asarray(edges))[0][0])
+        meta["in"] = [-thr, thr]
+
+
+def _build_stages(plan):
     stages = []
-    for si, (kind, layer, extras) in enumerate(plan):
+    for kind, layer, extras, meta in plan:
         if kind in ("float_conv", "float_dense"):
             # excluded layer: keep fp32 math with the folded weights
             w, b = extras
@@ -309,11 +373,10 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
         elif kind in ("conv", "dense"):
             w, b = extras
             payload = _quantize_weights(w, b)
-            mn, mx = ranges[si]
-            payload.update(min_in=mn, max_in=mx)
-            if si in out_ranges:
-                payload.update(min_out=out_ranges[si][0],
-                               max_out=out_ranges[si][1])
+            payload.update(min_in=meta["in"][0], max_in=meta["in"][1])
+            if "out" in meta:
+                payload.update(min_out=meta["out"][0],
+                               max_out=meta["out"][1])
             if kind == "conv":
                 payload["kwargs"] = dict(layer._kwargs)
                 payload["kwargs"].pop("no_bias", None)
@@ -326,9 +389,59 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8",
             stages.append(("pool", {
                 "kwargs": dict(lay._kwargs),
                 "fn": (lambda r, _l=lay: _l(NDArray(r)).data)}))
+        elif kind == "bn":
+            stages.append(("bn", {
+                "layer": layer,
+                "gamma": layer.gamma.data().data,
+                "beta": layer.beta.data().data,
+                "mean": layer.running_mean.data().data,
+                "var": layer.running_var.data().data,
+                "eps": layer._kwargs.get("eps", 1e-5)}))
+        elif kind == "residual":
+            stages.append(("residual", {
+                "body": _build_stages(extras["body"]),
+                "shortcut": (_build_stages(extras["shortcut"])
+                             if extras["shortcut"] else None),
+                "out_range": meta.get("out")}))
         else:
             stages.append((kind, None))
-    return QuantizedNet(stages)
+    return stages
+
+
+def quantize_net(net, calib_data=None, quantized_dtype="int8",
+                 calib_mode="naive", exclude_layers=()):
+    """Post-training-quantize a supported Gluon block (including zoo
+    ResNet V1 residual topologies — the skip-adds run as int8
+    ``quantized_elemwise_add``, so activations never leave int8 between
+    calibrated stages).
+
+    calib_data: iterable of input batches (NDArray or array-like) run
+    through the fp32 net to record per-layer activation ranges.
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 quantization is implemented "
+                         "(reference default); use amp for bf16")
+    if calib_mode not in ("naive", "entropy"):
+        raise MXNetError("calib_mode must be 'naive' (min/max) or "
+                         "'entropy' (KL-minimizing threshold, reference "
+                         "calibrate.cc)")
+    plan = _plan_layers(_walk(net), exclude_layers)
+
+    if calib_data is None:
+        raise MXNetError("calib_data is required for calibration")
+    for batch in calib_data:
+        raw = batch.data if isinstance(batch, NDArray) \
+            else jnp.asarray(batch)
+        _calib_run(plan, raw, calib_mode)
+
+    if calib_mode == "entropy":
+        # KL-minimizing symmetric thresholds (reference calibrate.cc via
+        # the _contrib_calibrate_entropy op)
+        from ..ops.registry import get as _get_op
+
+        _entropy_pass(plan, _get_op("calibrate_entropy").fn)
+
+    return QuantizedNet(_build_stages(plan))
 
 
 # reference-name compatibility wrappers ------------------------------------

@@ -231,6 +231,61 @@ def quantized_act(data, min_data, max_data, act_type="relu"):
     return q, qmn, qmx
 
 
+@register("quantized_elemwise_add",
+          aliases=("_contrib_quantized_elemwise_add",))
+def quantized_elemwise_add(a, b, min_a, max_a, min_b, max_b,
+                           min_calib_range=None, max_calib_range=None):
+    """int8 residual add (reference: ``src/operator/quantization/
+    quantized_elemwise_add.cc``). Operands are rescaled into the output
+    range — the calibrated one when provided (requantize-style), else
+    the conservative |a|max + |b|max — so a quantized ResNet's skip
+    connections stay int8 end-to-end. On TPU the rescale runs as a VPU
+    multiply on the int8 values; no float tensor materialises."""
+    abs_a = jnp.maximum(jnp.abs(min_a), jnp.abs(max_a))
+    abs_b = jnp.maximum(jnp.abs(min_b), jnp.abs(max_b))
+    oa = abs_a / 127.0  # float value per int8 step
+    ob = abs_b / 127.0
+    if min_calib_range is not None:
+        out_abs = jnp.maximum(jnp.abs(min_calib_range),
+                              jnp.abs(max_calib_range))
+    else:
+        out_abs = abs_a + abs_b
+    # same degenerate-range floor as _symmetric_scale: all-zero inputs
+    # must yield zeros, not 0/0 NaN cast to int8
+    out_step = jnp.maximum(out_abs, 1e-30) / 127.0
+    s = jnp.round(a.astype(jnp.float32) * (oa / out_step)
+                  + b.astype(jnp.float32) * (ob / out_step))
+    out = jnp.clip(s, -127, 127).astype(jnp.int8)
+    return out, -out_abs, out_abs
+
+
+@register("quantized_batch_norm",
+          aliases=("_contrib_quantized_batch_norm",))
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3, axis=1):
+    """int8 inference BatchNorm (reference: ``src/operator/quantization/
+    quantized_batch_norm.cc``): running-stat affine applied per channel
+    directly on the int8 values, output re-symmetrised into a range
+    computed from the params — no float tensor in between.
+
+    out_float = (x - mean) * gamma/sigma + beta = x * a_c + b_c, so the
+    output bound is max_c(|a_c| * absmax_in + |b_c|)."""
+    absmax_in = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    a_c = gamma * lax.rsqrt(moving_var + eps)
+    b_c = beta - moving_mean * a_c
+    out_abs = jnp.max(jnp.abs(a_c) * absmax_in + jnp.abs(b_c))
+    in_step = absmax_in / 127.0
+    out_step = jnp.maximum(out_abs, 1e-30) / 127.0
+    shape = [1] * data.ndim
+    shape[axis] = -1
+    shape = tuple(shape)
+    s = jnp.round(data.astype(jnp.float32)
+                  * (a_c * in_step / out_step).reshape(shape)
+                  + (b_c / out_step).reshape(shape))
+    out = jnp.clip(s, -127, 127).astype(jnp.int8)
+    return out, -out_abs, out_abs
+
+
 @register("quantized_concat", aliases=("_contrib_quantized_concat",))
 def quantized_concat(*args, dim=1):
     """int8 concat with range unification (reference quantized_concat.cc):

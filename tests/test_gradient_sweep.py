@@ -154,6 +154,7 @@ EXCLUDED = {
     "quantized_act": QUANT, "quantized_conv": QUANT,
     "quantized_flatten": QUANT, "quantized_fully_connected": QUANT,
     "quantized_pooling": QUANT, "quantized_concat": QUANT, "requantize": QUANT, "dequantize": QUANT,
+    "quantized_elemwise_add": QUANT, "quantized_batch_norm": QUANT,
     "calibrate_entropy": QUANT,
     "intgemm_fully_connected": QUANT, "intgemm_maxabsolute": QUANT,
     "intgemm_prepare_data": QUANT, "intgemm_prepare_weight": QUANT,
@@ -176,6 +177,12 @@ EXCLUDED = {
     "flash_attention":
         "gradients covered by tests_tpu/test_pallas_flash.py + "
         "test_attention_models.py reference-vs-kernel checks",
+    "_contrib_fused_matmul_stats":
+        "hand-derived custom_vjp checked against jax autodiff in "
+        "test_fused_conv_bn.py (test_custom_vjp_matches_autodiff)",
+    "_contrib_fused_scaled_matmul_stats":
+        "hand-derived custom_vjp checked against jax autodiff in "
+        "test_fused_conv_bn.py (test_custom_vjp_matches_autodiff)",
     "sldwin_atten_score": "covered with flash_attention (banded kernels)",
     "sldwin_atten_context": "covered with flash_attention (banded kernels)",
     "_ctc_loss": "CTC gradient checked in test_contrib.py against torch",
