@@ -102,7 +102,8 @@ class TapeNode:
     of already-recorded consumers."""
 
     __slots__ = ("vjp_fn", "inputs", "input_slots", "n_outputs",
-                 "out_arrays", "out_cts", "name", "_order", "_replay")
+                 "out_arrays", "out_cts", "name", "_order", "_replay",
+                 "_sym_info")
 
     def __init__(self, vjp_fn, inputs, n_outputs, name=""):
         self.vjp_fn = vjp_fn
@@ -117,6 +118,8 @@ class TapeNode:
         # its tracked inputs. The raw values are the same objects the vjp
         # closure already holds, so this costs no extra device memory.
         self._replay = None
+        # (record-time args list, static kwargs) for get_symbol export
+        self._sym_info = None
 
 
 def _node_of(arr):
@@ -404,8 +407,113 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     return out[0] if single else out
 
 
-def get_symbol(x):  # reference parity stub (symbolic tape export)
-    raise NotImplementedError("autograd.get_symbol is not supported")
+def get_symbol(x):
+    """Export the recorded computation producing ``x`` as a Symbol
+    (reference: ``autograd.get_symbol`` -> ``MXAutogradGetSymbol``,
+    ``src/c_api/c_api_ndarray.cc``).
+
+    Walks the tape from ``x``'s producing node, emitting a symbolic op
+    per recorded op (names/attrs captured at record time) and a
+    ``var('varN')`` per distinct leaf NDArray, so the result round-trips
+    through ``Symbol.save`` / ``SymbolBlock.imports``."""
+    from .base import MXNetError
+    from .ndarray.ndarray import NDArray
+    from .symbol import op as symop
+    from .symbol.symbol import var
+
+    info = getattr(x, "_ag", None)
+    if info is None:
+        raise MXNetError("get_symbol: array is not on the tape (call "
+                         "inside autograd.record() on a tracked graph)")
+
+    # eager scalar binops record as broadcast_* with a plain-number arg;
+    # symbols represent those as the reference's *_scalar op family
+    # (which saved JSON graphs already use)
+    scalar_sym = {
+        "broadcast_add": ("_plus_scalar", "_plus_scalar"),
+        "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
+        "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
+        "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
+        "broadcast_mod": ("_mod_scalar", "_rmod_scalar"),
+        "broadcast_power": ("_power_scalar", "_rpower_scalar"),
+        "broadcast_maximum": ("_maximum_scalar", "_maximum_scalar"),
+        "broadcast_minimum": ("_minimum_scalar", "_minimum_scalar"),
+        "broadcast_hypot": ("_hypot_scalar", "_hypot_scalar"),
+        "broadcast_equal": ("_equal_scalar", "_equal_scalar"),
+        "broadcast_not_equal": ("_not_equal_scalar", "_not_equal_scalar"),
+        "broadcast_greater": ("_greater_scalar", "_lesser_scalar"),
+        "broadcast_greater_equal": ("_greater_equal_scalar",
+                                    "_lesser_equal_scalar"),
+        "broadcast_lesser": ("_lesser_scalar", "_greater_scalar"),
+        "broadcast_lesser_equal": ("_lesser_equal_scalar",
+                                   "_greater_equal_scalar"),
+    }
+
+    node_memo = {}
+    leaf_memo = {}
+    counter = [0]
+
+    def leaf(arr):
+        key = id(arr)
+        if key not in leaf_memo:
+            leaf_memo[key] = var(f"var{counter[0]}")
+            counter[0] += 1
+        return leaf_memo[key]
+
+    def build(node):
+        if id(node) in node_memo:
+            return node_memo[id(node)]
+        if node._sym_info is None:
+            raise MXNetError(
+                f"get_symbol: op '{node.name}' was recorded without "
+                "symbol info (custom Function / functional record); "
+                "the tape cannot be exported")
+        args, kwargs = node._sym_info
+        slot_of = {id(i): s for i, s in zip(node.inputs, node.input_slots)}
+        sym_args = []
+        for a in args:
+            if not isinstance(a, NDArray):
+                sym_args.append(a)
+                continue
+            slot = slot_of.get(id(a))
+            if slot is None:
+                sym_args.append(leaf(a))
+            else:
+                pnode, k = slot
+                psym = build(pnode)
+                sym_args.append(psym[k] if pnode.n_outputs > 1 else psym)
+        import numbers
+
+        def is_num(a):
+            return isinstance(a, numbers.Number) \
+                and not isinstance(a, bool)
+
+        name = node.name
+        if name in scalar_sym and len(sym_args) == 2 \
+                and any(is_num(a) for a in sym_args):
+            if is_num(sym_args[1]):
+                name, data, scalar = scalar_sym[name][0], sym_args[0], \
+                    sym_args[1]
+            else:
+                name, data, scalar = scalar_sym[name][1], sym_args[1], \
+                    sym_args[0]
+            sym_args = [data]
+            kwargs = dict(kwargs, scalar=float(scalar))
+        elif any(is_num(a) for a in sym_args):
+            raise MXNetError(
+                f"get_symbol: op '{name}' was recorded with a plain "
+                "scalar operand and has no *_scalar symbol form")
+        fn = getattr(symop, name, None)
+        if fn is None:
+            raise MXNetError(
+                f"get_symbol: op '{name}' has no symbol binding")
+        sym = fn(*sym_args, **kwargs)
+        node_memo[id(node)] = sym
+        return sym
+
+    node, k = info
+    sym = build(node)
+    return sym[k] if node.n_outputs > 1 else sym
 
 
 class Function:

@@ -271,7 +271,10 @@ class NDArray:
     # ------------------------------------------------------------------
     def __getitem__(self, idx):
         if isinstance(idx, NDArray):
-            return NDArray(jnp.take(self.data, idx.data.astype(jnp.int32), axis=0),
+            # int32 gather indices wrap silently past 2^31; keep int64
+            # when x64 is live (the documented large-tensor posture)
+            idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+            return NDArray(jnp.take(self.data, idx.data.astype(idt), axis=0),
                            ctx=self._ctx)
         if _is_basic_index(idx):
             if autograd.is_recording() and autograd.is_tracked(self):
@@ -289,6 +292,18 @@ class NDArray:
             idx = idx.data
         if isinstance(idx, tuple):
             idx = tuple(i.data if isinstance(i, NDArray) else i for i in idx)
+        # without x64, scatter into a >2^31-element array picks int64
+        # indices that JAX then truncates to int32 and SILENTLY DROPS
+        # the update — turn the footgun into an error (see
+        # docs/design_decisions.md "Large-tensor support")
+        if self.size > 2**31 - 1:
+            import jax as _jax
+
+            if not _jax.config.jax_enable_x64:
+                raise MXNetError(
+                    f"in-place update on a {self.size}-element array "
+                    "requires int64 scatter indices: enable "
+                    "jax_enable_x64 (INT64_TENSOR_SIZE feature)")
         val_nd = value if isinstance(value, NDArray) else None
         v = val_nd if val_nd is not None else value
         if isinstance(v, (list, tuple, _np.ndarray)):

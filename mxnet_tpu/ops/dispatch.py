@@ -115,6 +115,7 @@ def _apply_recorded(opdef, args, raw, kwargs, tracked_idx, ctx, out):
         vjp_fn, [args[i] for i in tracked_idx], len(outs), name=opdef.name
     )
     node._replay = (f, tracked_raw)  # for grad(create_graph=True)
+    node._sym_info = (list(args), dict(kwargs))  # for get_symbol export
     node.out_arrays = list(outs)
     for k, o in enumerate(outs):
         o._ag = (node, k)

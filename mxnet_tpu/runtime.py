@@ -34,7 +34,14 @@ class Features(dict):
             "MKLDNN": False,
             "OPENCV": _has_pillow(),
             "DIST_KVSTORE": True,
-            "INT64_TENSOR_SIZE": True,
+            # >2^31-element arrays: value ops (create/elementwise/
+            # reduce/matmul rows) work on host at any size, but
+            # INDEX-producing ops (argmax/argsort/take, big slice
+            # offsets) need int64 index types, which JAX only enables
+            # globally via jax_enable_x64 — report accordingly
+            # (reference: MXNET_INT64_TENSOR_SIZE build flag;
+            # tests/test_large_tensor.py; docs/design_decisions.md)
+            "INT64_TENSOR_SIZE": bool(jax.config.jax_enable_x64),
             "SIGNAL_HANDLER": True,
             "F16C": True,
             "BF16": True,

@@ -237,3 +237,47 @@ def test_setitem_grad_req_add_no_double_count():
     s.backward()
     np.testing.assert_allclose(a.grad.asnumpy(), [2, 0, 2, 2])
     np.testing.assert_allclose(v.grad.asnumpy(), [10.0])
+
+
+def test_get_symbol_exports_tape():
+    """autograd.get_symbol (reference: MXAutogradGetSymbol) exports the
+    recorded tape as a Symbol that round-trips through Symbol.save +
+    SymbolBlock.imports with identical outputs."""
+    import os
+    import tempfile
+
+    import numpy as np
+
+    from mxnet_tpu import gluon
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(4, 6).astype(np.float32))
+    w1 = mx.nd.array(rng.randn(8, 6).astype(np.float32) * 0.3)
+    b1 = mx.nd.array(np.zeros(8, np.float32))
+    w2 = mx.nd.array(rng.randn(3, 8).astype(np.float32) * 0.3)
+    for a in (x, w1, b1, w2):
+        a.attach_grad()
+    with autograd.record():
+        h = mx.nd.relu(mx.nd.FullyConnected(x, w1, b1, num_hidden=8))
+        out = mx.nd.FullyConnected(h, w2, no_bias=True, num_hidden=3)
+    sym = autograd.get_symbol(out)
+    args = sym.list_arguments()
+    assert len(args) == 4, args
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "tape-symbol.json")
+        sym.save(path)
+        # identify which varN is which by shape
+        shapes = {"var0": x, "var1": w1, "var2": b1, "var3": w2}
+        net = gluon.SymbolBlock.imports(path, ["var0"])
+        for name, p in net.collect_params().items():
+            p._load_init(shapes[name], None)
+        y2 = net(x)
+    np.testing.assert_allclose(y2.asnumpy(), out.asnumpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_get_symbol_untracked_raises():
+    x = mx.nd.array([1.0, 2.0])
+    with pytest.raises(mx.base.MXNetError):
+        autograd.get_symbol(x)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""One-bottleneck-block probe: where does the integrated fused fwd lose
+time vs plain XLA? Also dumps HLO op histograms to spot layout copies.
+
+Dataflow mirrors the integrated net exactly:
+  in -> c1(1x1) -> bn1 -> relu -> c2(3x3 XLA) -> bn2 -> relu
+     -> c3(1x1) -> bn3 -> (+in) -> relu
+variants: xla (all XLA), pal (c1/c3 pallas+stats, XLA apply).
+"""
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from mxnet_tpu.ops import fused_conv_bn as F
+from mxnet_tpu.test_utils import chain_time_per_iter
+
+B, H, W, C = 128, 56, 56, 256
+CMID = 64
+M = B * H * W
+
+
+def bn_apply(y, relu=True):
+    yf = y.astype(jnp.float32)
+    mean = jnp.mean(yf, axis=(0, 1, 2))
+    var = jnp.maximum(jnp.mean(yf * yf, axis=(0, 1, 2)) - mean * mean, 0.0)
+    inv = lax.rsqrt(var + 1e-5)
+    out = (y - mean.astype(y.dtype)) * inv.astype(y.dtype)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out
+
+
+def bn_apply_from_stats(y, ysum, yssq, relu=True):
+    mean = ysum / M
+    var = jnp.maximum(yssq / M - mean * mean, 0.0)
+    inv = lax.rsqrt(var + 1e-5)
+    out = (y - mean.astype(y.dtype)) * inv.astype(y.dtype)
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out
+
+
+def make_block(kind, w1, w2, w3):
+    def conv3x3(x, w):
+        return lax.conv_general_dilated(
+            x, w, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    def block(x):
+        if kind == "xla":
+            y1 = jnp.einsum("bhwc,cd->bhwd", x, w1)
+            a1 = bn_apply(y1)
+            y2 = conv3x3(a1, w2)
+            a2 = bn_apply(y2)
+            y3 = jnp.einsum("bhwc,cd->bhwd", a2, w3)
+            a3 = bn_apply(y3, relu=False)
+        else:
+            y1, s1, q1 = F._fused_fwd_pallas(x.reshape(M, C), w1, None, None)
+            a1 = bn_apply_from_stats(y1, s1, q1).reshape(B, H, W, CMID)
+            y2 = conv3x3(a1, w2)
+            y3, s3, q3 = F._fused_fwd_pallas(
+                bn_apply(y2).reshape(M, CMID), w3, None, None)
+            a3 = bn_apply_from_stats(y3, s3, q3, relu=False) \
+                .reshape(B, H, W, C)
+        return jnp.maximum(a3 + x, 0.0)
+
+    return block
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(B, H, W, C), jnp.bfloat16)
+    w1 = jnp.asarray(rng.randn(C, CMID) * 0.05, jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(3, 3, CMID, CMID) * 0.05, jnp.bfloat16)
+    w3 = jnp.asarray(rng.randn(CMID, C) * 0.05, jnp.bfloat16)
+
+    for kind in ("xla", "pal"):
+        block = make_block(kind, w1, w2, w3)
+
+        def step(xc):
+            out = block(xc)
+            return xc + (jnp.sum(out.astype(jnp.float32))
+                         * jnp.float32(1e-30)).astype(xc.dtype)
+
+        ms = chain_time_per_iter(step, x, n1=20, n2=120, reps=3) * 1e3
+        print(f"{kind}: {ms:.3f} ms/block-fwd", flush=True)
+        if os.environ.get("DUMP_HLO") == "1":
+            txt = jax.jit(step).lower(x).compile().as_text()
+            ops = Counter()
+            for key in ("fusion(", "copy(", "transpose(", "custom-call(",
+                        "convolution(", "dot(", "reduce(", "bitcast("):
+                ops[key.rstrip("(")] = txt.count(key)
+            print(f"  HLO: {dict(ops)}", flush=True)
+            with open(f"/tmp/hlo_{kind}.txt", "w") as f:
+                f.write(txt)
+
+
+if __name__ == "__main__":
+    main()
