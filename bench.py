@@ -239,7 +239,10 @@ def bench_flash_attention(backend):
             # caps at T=8k — see flash_attention._PALLAS_BWD_MAX_T)
             return fa.flash_attention(x, kl, vl, window=W, block_size=1024)
 
-        per_w = chain_time_per_iter(fstep_w, ql, 10, 60)
+        # long chains + reps: at ~2.4 ms/iter the (10, 60) two-point
+        # slope scattered 23-30 TFLOP/s run-to-run (r4's recorded 23.8
+        # was such a low draw); (20, 120) x4 is stable within ~5%
+        per_w = chain_time_per_iter(fstep_w, ql, 20, 120, reps=4)
         # band area ~= T*W (minus the triangular ramp-in, negligible)
         flops_w = 2 * 2 * 1 * H * Tl * W * D
         _emit(f"flash_attention_sldwin_fwd_T{Tl}_W{W}_D{D}_{backend}",

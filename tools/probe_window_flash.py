@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Block-size sweep for the banded sliding-window flash kernel
+(VERDICT r5 #7: get flash_attention_sldwin >= 40 TFLOP/s useful-FLOPs).
+
+Band overhead by square block size b (window W=1024): computed/useful =
+(ceil((W-1)/b) + 1) * b / W -> 2.0x @1024, 1.5x @512, 1.25x @256,
+1.125x @128; smaller blocks trade mask waste for grid/DMA overhead.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu.ops import flash_attention as fa
+from mxnet_tpu.test_utils import chain_time_per_iter
+
+H, D = 8, 64
+Tl, W = 32768, 1024
+
+
+def main():
+    rng = np.random.RandomState(0)
+    ql = jnp.asarray(rng.randn(1, H, Tl, D), jnp.bfloat16)
+    kl = jnp.asarray(rng.randn(1, H, Tl, D), jnp.bfloat16)
+    vl = jnp.asarray(rng.randn(1, H, Tl, D), jnp.bfloat16)
+    flops_w = 2 * 2 * 1 * H * Tl * W * D
+    for bs in (1024, 512, 256, 128):
+        def fstep(x, _bs=bs):
+            return fa.flash_attention(x, kl, vl, window=W, block_size=_bs)
+
+        per = chain_time_per_iter(fstep, ql, 20, 120, reps=4)
+        print(f"block={bs:5d}: {per*1e3:7.3f} ms  "
+              f"{flops_w/per/1e12:6.2f} TFLOP/s useful", flush=True)
+
+
+if __name__ == "__main__":
+    main()
