@@ -151,3 +151,62 @@ def test_spmd_step_multiprocess_multidevice(nprocs, ndev):
 
     _np.testing.assert_allclose(float(losses[0]), float(ref_loss),
                                 rtol=1e-5, atol=1e-7)
+
+
+PP_EP_WORKER = os.path.join(ROOT, "tests", "distributed", "pp_ep_worker.py")
+
+
+@pytest.mark.parametrize("nprocs,ndev", [(2, 4), (4, 2)])
+def test_pp_ep_multiprocess_multidevice(nprocs, ndev):
+    """VERDICT r5 #9: pipeline (pp) and MoE (ep) under REAL multi-process
+    SPMD, not only the single-process dryrun: the GPipe grad step and the
+    expert-parallel forward must produce the same scalars on an
+    N-process x M-device global mesh as on 1 process x 8 devices."""
+    import re
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    base_flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+
+    env1 = dict(env)
+    env1["XLA_FLAGS"] = (base_flags
+                         + " --xla_force_host_platform_device_count=8")
+    ref = subprocess.run([sys.executable, PP_EP_WORKER], env=env1,
+                         capture_output=True, text=True, timeout=600)
+    assert ref.returncode == 0, ref.stderr[-3000:]
+    m = re.search(r"PP_EP_OK rank=0/1 (.*)", ref.stdout)
+    assert m, (f"reference worker printed no OK line\nstdout:\n"
+               f"{ref.stdout[-2000:]}\nstderr:\n{ref.stderr[-2000:]}")
+    ref_line = m.group(1)
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        env2 = dict(env)
+        env2["XLA_FLAGS"] = (
+            base_flags
+            + f" --xla_force_host_platform_device_count={ndev}")
+        env2["MXTPU_TEST_OUTDIR"] = td
+        res = subprocess.run(
+            [sys.executable, LAUNCH, "-n", str(nprocs),
+             "--coordinator", f"127.0.0.1:{_free_port()}",
+             sys.executable, PP_EP_WORKER],
+            env=env2, capture_output=True, text=True, timeout=900)
+        assert res.returncode == 0, (
+            f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
+            f"stderr:\n{res.stderr[-4000:]}")
+        lines = []
+        for r in range(nprocs):
+            with open(os.path.join(td, f"rank{r}.txt")) as f:
+                m = re.search(rf"PP_EP_OK rank={r}/{nprocs} (.*)",
+                              f.read())
+                assert m, f"rank {r} output malformed"
+                lines.append(m.group(1).strip())
+    assert len(set(lines)) == 1, lines  # all ranks agree
+    ref_vals = [float(x.split("=")[1]) for x in ref_line.split()]
+    got_vals = [float(x.split("=")[1]) for x in lines[0].split()]
+    import numpy as _np
+
+    _np.testing.assert_allclose(got_vals, ref_vals, rtol=1e-5)

@@ -1,0 +1,75 @@
+"""Dispatch-latency budget gate (VERDICT r5 #6).
+
+The opperf harness (benchmark/opperf.py) is the per-op record; this
+smoke test makes dispatch-latency REGRESSIONS visible round-to-round by
+failing the suite when the imperative path slows down. Budgets are ~6x
+the measured r5 values on this container (eager add (4,4): ~0.023 ms;
+record+backward roundtrip: ~2.3 ms), so environment jitter passes but a
+dispatch-path regression (an accidental sync, a cache-key rebuild, a
+tape-overhead blowup) fails loudly.
+
+Reference analog: benchmark/opperf's use in MXNet CI to track
+``Imperative::Invoke`` overhead.
+"""
+
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+EAGER_BUDGET_MS = 0.15
+BACKWARD_BUDGET_MS = 14.0
+
+
+def _best_of(fn, reps=3):
+    best = None
+    for _ in range(reps):
+        t = fn()
+        best = t if best is None or t < best else best
+    return best
+
+
+def test_eager_dispatch_latency_budget():
+    a = mx.nd.array(np.ones((4, 4), np.float32))
+    b = mx.nd.array(np.ones((4, 4), np.float32))
+    for _ in range(100):
+        c = a + b  # warm the jit/attr caches
+
+    def run():
+        n = 1000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c = a + b
+        c.asnumpy()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    ms = _best_of(run)
+    assert ms < EAGER_BUDGET_MS, (
+        f"eager dispatch {ms:.4f} ms/op exceeds the {EAGER_BUDGET_MS} ms "
+        "budget — check ops/dispatch.py for new per-call work")
+
+
+def test_record_backward_roundtrip_budget():
+    a = mx.nd.array(np.ones((8, 8), np.float32))
+    b = mx.nd.array(np.ones((8, 8), np.float32))
+    a.attach_grad()
+    for _ in range(10):
+        with autograd.record():
+            c = (a + b).sum()
+        c.backward()
+
+    def run():
+        n = 100
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with autograd.record():
+                c = (a + b).sum()
+            c.backward()
+        return (time.perf_counter() - t0) / n * 1e3
+
+    ms = _best_of(run)
+    assert ms < BACKWARD_BUDGET_MS, (
+        f"record+backward {ms:.4f} ms exceeds the {BACKWARD_BUDGET_MS} ms "
+        "budget — check autograd tape / vjp dispatch overhead")

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Where do BERT's 62 ms/step go? (VERDICT r5 #2: recover >=1062
+samples/s, push toward 50% MFU.)
+
+Variants (all SPMDTrainStep, bs64 seq128 bf16):
+  full      bench configuration (adam, MLM CE over 30522 vocab)
+  meanhead  loss = mean(logits) — drops log_softmax+pick, keeps decoder
+  nodec     model without the vocab decoder, loss = mean(hidden)
+  sgd       full loss but SGD (isolates adam update cost)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def run(variant, steps=60):
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, gluon, parallel
+    from mxnet_tpu.models import bert as bert_mod
+
+    batch, seqlen, vocab = 64, 128, 30522
+    net = bert_mod.bert_base(dropout=0.0, use_pooler=False,
+                             use_classifier=False,
+                             use_decoder=(variant != "nodec"))
+    net.initialize(init=mx.initializer.Normal(0.02))
+    net.cast("bfloat16")
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def mlm_loss(out, y):
+        logits = out[-1] if isinstance(out, (tuple, list)) else out
+        return sce(logits, y)
+
+    def mean_loss(out, y):
+        logits = out[-1] if isinstance(out, (tuple, list)) else out
+        return logits.astype("float32").mean()
+
+    loss_fn = mlm_loss if variant in ("full", "sgd") else mean_loss
+    opt = "sgd" if variant == "sgd" else "adam"
+    okw = {} if variant == "sgd" else {"wd": 0.01}
+    step = parallel.SPMDTrainStep(net, loss_fn, opt, okw, mesh=None)
+    x = mx.nd.array(np.random.randint(0, vocab, (batch, seqlen)),
+                    dtype="int32")
+    y = mx.nd.array(np.random.randint(0, vocab, (batch, seqlen))
+                    .astype(np.float32))
+    step(x, y, lr=1e-4, sync=False)
+    engine.wait(step.run_steps(x, y, 2, lr=1e-4))
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        engine.wait(step.run_steps(x, y, steps, lr=1e-4))
+        dt = time.perf_counter() - t0
+        best = dt if best is None or dt < best else best
+    ms = best / steps * 1e3
+    print(f"{variant:9s}: {ms:6.2f} ms/step  "
+          f"{batch * steps / best:7.1f} samples/s", flush=True)
+
+
+if __name__ == "__main__":
+    for v in (sys.argv[1:] or ["full", "meanhead", "nodec", "sgd"]):
+        run(v)
