@@ -122,7 +122,99 @@ def bench_resnet(backend):
           "images/sec", img_s / BASELINE_RESNET_IMG_S,
           step_ms=step_ms, tflops=tflops,
           mfu=(tflops / peak) if peak else None, steps=steps)
+    if backend != "cpu" and os.environ.get("BENCH_PIPELINE") == "1":
+        _bench_resnet_pipeline_fed(step, batch, size, dtype, img_s)
     return img_s
+
+
+def _bench_resnet_pipeline_fed(step, batch, size, dtype, synthetic_img_s):
+    """Feed the SAME compiled train step from the C++ RecordIO/JPEG
+    pipeline (cxx/libmxtpu.so: decode+augment+batch on native threads
+    with prefetch) and record end-to-end img/s next to the synthetic
+    number (VERDICT r5 #3; reference: ImageRecordIOParser2 threaded
+    decode in src/io/iter_image_recordio_2.cc).
+
+    NOTE this container exposes ONE CPU core (nproc=1), which caps
+    single-host JPEG decode at ~1k img/s regardless of the pipeline
+    design — the io_pipeline_host row isolates that host-side rate so
+    the device-feed overhead is visible separately (see BASELINE.md)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+
+    rec = _make_bench_rec(n=512, hw=(size, size))
+    nthreads = os.cpu_count() or 1
+    it = mx.io.ImageRecordIter(path_imgrec=rec, data_shape=(3, size, size),
+                               batch_size=batch, shuffle=False,
+                               preprocess_threads=nthreads,
+                               prefetch_buffer=4)
+    # host-side iterator-only throughput (decode+batch, no device);
+    # pop one batch before t0 so the prefetch warmup doesn't inflate
+    # the rate, and wrap epochs until >= 1024 images are counted
+    next(it)
+    n_host = 0
+    t0 = time.perf_counter()
+    while n_host < 1024:
+        try:
+            next(it)
+        except StopIteration:
+            it.reset()
+            continue
+        n_host += batch
+    host_img_s = n_host / (time.perf_counter() - t0)
+    _emit("io_pipeline_host_jpeg_decode", host_img_s, "images/sec",
+          None, threads=nthreads)
+
+    # end-to-end: pipeline -> device feed -> train step (async dispatch
+    # overlaps the next batch's decode)
+    steps_fed = int(os.environ.get("BENCH_PIPE_STEPS", "20"))
+    it.reset()
+    done = 0
+    loss = None
+    t0 = time.perf_counter()
+    while done < steps_fed:
+        try:
+            b = next(it)
+        except StopIteration:
+            it.reset()
+            continue
+        x = b.data[0].astype(dtype) if dtype != "float32" else b.data[0]
+        y = b.label[0].reshape((batch,))
+        loss = step(x, y, lr=0.05, sync=False)
+        done += 1
+    engine.wait(loss)
+    dt = time.perf_counter() - t0
+    fed_img_s = batch * steps_fed / dt
+    _emit(f"resnet50_pipeline_fed_{dtype}_bs{batch}_tpu", fed_img_s,
+          "images/sec", None, step_ms=dt / steps_fed * 1e3,
+          pct_of_synthetic=round(fed_img_s / synthetic_img_s, 4))
+
+
+def _make_bench_rec(n=256, hw=(224, 224)):
+    """Synthetic JPEG ImageRecord pack, cached across runs."""
+    import io as _io
+
+    import numpy as np
+
+    cache = f"/tmp/mxtpu_bench_{hw[0]}x{hw[1]}_{n}.rec"
+    idx = cache[:-4] + ".idx"
+    if os.path.exists(cache) and os.path.exists(idx):
+        return cache
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    w = recordio.MXIndexedRecordIO(idx, cache, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = (rng.rand(hw[0], hw[1], 3) * 255).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=90)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 10), i, 0), buf.getvalue()))
+    w.close()
+    return cache
 
 
 def bench_bert(backend):

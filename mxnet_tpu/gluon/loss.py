@@ -70,10 +70,10 @@ class SoftmaxCrossEntropyLoss(Loss):
             # vocab that tensor costs ~2 ms/step of pure HBM traffic —
             # and the reduction accumulates in f32 regardless of pred's
             # dtype (bf16 logsumexp over 30k classes is sloppy)
-            lse = F.logsumexp(pred.astype("float32"), axis=self._axis,
-                              keepdims=True)
+            lse = F.logsumexp(F.cast(pred, dtype="float32"),
+                              axis=self._axis, keepdims=True)
             picked = F.pick(pred, label, axis=self._axis, keepdims=True)
-            loss = lse - picked.astype("float32")
+            loss = lse - F.cast(picked, dtype="float32")
         else:
             if not self._from_logits:
                 pred = F.log_softmax(pred, axis=self._axis)

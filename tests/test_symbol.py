@@ -132,3 +132,15 @@ def test_check_symbolic_helpers():
     with _pytest.raises(AssertionError):
         tu.check_symbolic_forward(net, {"x": xd, "w": wd},
                                   [np.zeros((2, 3), np.float32)])
+
+
+def test_loss_blocks_trace_symbolically():
+    """The gluon losses must trace with Symbol inputs (export path) —
+    the r5 lse-pick rewrite briefly used NDArray-only .astype (review
+    regression)."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.symbol.symbol import var
+
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    s = sce(var("pred"), var("label"))
+    assert set(s.list_arguments()) == {"pred", "label"}
