@@ -26,19 +26,34 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_launcher(nworkers, timeout=300):
+def _base_env(ndev=None, **extra):
+    """CPU-backed env for launcher subprocesses: strips the conftest's
+    8-device force flag (each worker decides its own device count via
+    ``ndev``). THE shared copy — every dist test builds on this so the
+    env contract changes in exactly one place."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
-    # one device per worker process is enough; drop the 8-device force flag
-    env["XLA_FLAGS"] = " ".join(
+    flags = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "host_platform_device_count" not in f)
-    res = subprocess.run(
+    if ndev is not None:
+        flags += f" --xla_force_host_platform_device_count={ndev}"
+    env["XLA_FLAGS"] = flags
+    env.update(extra)
+    return env
+
+
+def _launch(worker, nworkers, env=None, timeout=300):
+    return subprocess.run(
         [sys.executable, LAUNCH, "-n", str(nworkers),
          "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, WORKER],
-        env=env, capture_output=True, text=True, timeout=timeout)
-    return res
+         sys.executable, worker],
+        env=env if env is not None else _base_env(),
+        capture_output=True, text=True, timeout=timeout)
+
+
+def _run_launcher(nworkers, timeout=300):
+    return _launch(WORKER, nworkers, timeout=timeout)
 
 
 @pytest.mark.parametrize("nworkers", [2, 3])
@@ -59,16 +74,7 @@ def test_fm_sparse_dist_training():
     """BASELINE config #4: FM converges on synthetic CTR under
     tools/launch.py -n 2 with row_sparse gradient pushes, and all ranks
     end with identical parameters."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f)
-    res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2",
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, FM_WORKER],
-        env=env, capture_output=True, text=True, timeout=600)
+    res = _launch(FM_WORKER, 2, timeout=600)
     assert res.returncode == 0, (
         f"launcher rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
         f"stderr:\n{res.stderr[-4000:]}")
@@ -87,17 +93,8 @@ def test_sharded_checkpoint_multiprocess(tmp_path):
     """spmd_save_states/load_states across 2 REAL processes: each rank
     writes only its addressable shards (ZeRO moments split), restore is
     bit-exact on every rank."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f)
-    env["MXTPU_TEST_CKPT_DIR"] = str(tmp_path)
-    res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", "2",
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, CKPT_WORKER],
-        env=env, capture_output=True, text=True, timeout=300)
+    res = _launch(CKPT_WORKER, 2,
+                  env=_base_env(MXTPU_TEST_CKPT_DIR=str(tmp_path)))
     assert res.returncode == 0, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
         f"stderr:\n{res.stderr[-4000:]}")
@@ -114,17 +111,8 @@ def test_spmd_step_multiprocess_multidevice(nprocs, ndev):
     chips. Run the fused SPMDTrainStep on an N-process x M-device global
     mesh (8 devices total, dp=4 x tp=2) and assert the final loss equals
     a 1-process 8-device run of the same program."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    base_flags = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f)
-
     # reference: single process, 8 local devices
-    env1 = dict(env)
-    env1["XLA_FLAGS"] = (base_flags
-                         + " --xla_force_host_platform_device_count=8")
-    ref = subprocess.run([sys.executable, SPMD_WORKER], env=env1,
+    ref = subprocess.run([sys.executable, SPMD_WORKER], env=_base_env(8),
                          capture_output=True, text=True, timeout=300)
     assert ref.returncode == 0, ref.stderr[-3000:]
     import re
@@ -132,14 +120,7 @@ def test_spmd_step_multiprocess_multidevice(nprocs, ndev):
     ref_loss = re.search(r"loss=([0-9.]+)", ref.stdout).group(1)
 
     # N processes x M devices each over the launcher
-    env2 = dict(env)
-    env2["XLA_FLAGS"] = (base_flags
-                         + f" --xla_force_host_platform_device_count={ndev}")
-    res = subprocess.run(
-        [sys.executable, LAUNCH, "-n", str(nprocs),
-         "--coordinator", f"127.0.0.1:{_free_port()}",
-         sys.executable, SPMD_WORKER],
-        env=env2, capture_output=True, text=True, timeout=600)
+    res = _launch(SPMD_WORKER, nprocs, env=_base_env(ndev), timeout=600)
     assert res.returncode == 0, (
         f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
         f"stderr:\n{res.stderr[-4000:]}")
@@ -164,16 +145,7 @@ def test_pp_ep_multiprocess_multidevice(nprocs, ndev):
     N-process x M-device global mesh as on 1 process x 8 devices."""
     import re
 
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    base_flags = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f)
-
-    env1 = dict(env)
-    env1["XLA_FLAGS"] = (base_flags
-                         + " --xla_force_host_platform_device_count=8")
-    ref = subprocess.run([sys.executable, PP_EP_WORKER], env=env1,
+    ref = subprocess.run([sys.executable, PP_EP_WORKER], env=_base_env(8),
                          capture_output=True, text=True, timeout=600)
     assert ref.returncode == 0, ref.stderr[-3000:]
     m = re.search(r"PP_EP_OK rank=0/1 (.*)", ref.stdout)
@@ -184,16 +156,9 @@ def test_pp_ep_multiprocess_multidevice(nprocs, ndev):
     import tempfile
 
     with tempfile.TemporaryDirectory() as td:
-        env2 = dict(env)
-        env2["XLA_FLAGS"] = (
-            base_flags
-            + f" --xla_force_host_platform_device_count={ndev}")
-        env2["MXTPU_TEST_OUTDIR"] = td
-        res = subprocess.run(
-            [sys.executable, LAUNCH, "-n", str(nprocs),
-             "--coordinator", f"127.0.0.1:{_free_port()}",
-             sys.executable, PP_EP_WORKER],
-            env=env2, capture_output=True, text=True, timeout=900)
+        res = _launch(PP_EP_WORKER, nprocs,
+                      env=_base_env(ndev, MXTPU_TEST_OUTDIR=td),
+                      timeout=900)
         assert res.returncode == 0, (
             f"rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
             f"stderr:\n{res.stderr[-4000:]}")
