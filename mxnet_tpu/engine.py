@@ -11,6 +11,8 @@ from __future__ import annotations
 import contextlib
 import os
 
+from . import observability as _obs
+
 _BULK = {"size": 15}
 
 
@@ -73,8 +75,26 @@ def wait(tree):
     """
     import jax
 
-    if not _on_relay():
-        return jax.block_until_ready(tree)
+    relay = _on_relay()
+    if not _obs.ENABLED:
+        if not relay:
+            return jax.block_until_ready(tree)
+        return _relay_wait(tree)
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        if not relay:
+            return jax.block_until_ready(tree)
+        return _relay_wait(tree)
+    finally:
+        _obs.record_engine_wait("relay" if relay else "native",
+                                time.perf_counter() - t0)
+
+
+def _relay_wait(tree):
+    """Dependent-read sync for the axon relay (see :func:`wait`)."""
+    import jax
     import numpy as np
     import jax.numpy as jnp
 

@@ -22,11 +22,13 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import observability as _obs
 from .. import random as _random
 from ..base import MXNetError
 from ..context import Context, current_context
@@ -437,6 +439,7 @@ class _CachedGraph:
         self.block = block
         self._cache = {}
         self._params = None  # stable handle list, fixed order
+        self._last_key = None  # previous signature, for retrace diagnosis
 
     def _param_handles(self, ctx):
         params = sorted(self.block.collect_params().items())
@@ -470,11 +473,51 @@ class _CachedGraph:
             inputs_tracked,
         )
         entry = self._cache.get(key)
-        if entry is None:
-            entry = self._build(args, arrays, handles, diff_mask, ctx, training,
-                                recording, inputs_tracked)
-            self._cache[key] = entry
-        return entry(args, arrays, handles, ctx)
+        if entry is not None:
+            if _obs.ENABLED:
+                _obs.CACHEDOP_CACHE_HITS.inc(1, block=block_name(self.block))
+            self._last_key = key
+            return entry(args, arrays, handles, ctx)
+        cause = self._retrace_cause(key) if _obs.ENABLED else None
+        t0 = time.perf_counter()
+        entry = self._build(args, arrays, handles, diff_mask, ctx, training,
+                            recording, inputs_tracked)
+        self._cache[key] = entry
+        self._last_key = key
+        if not _obs.ENABLED:
+            return entry(args, arrays, handles, ctx)
+        try:
+            # time the build AND the first call: jax.jit is lazy, so the
+            # XLA trace+compile happens inside the first execution
+            return entry(args, arrays, handles, ctx)
+        finally:
+            _obs.record_compile(block_name(self.block),
+                                time.perf_counter() - t0, cause)
+
+    def _retrace_cause(self, new_key):
+        """Diff the new signature against the previous call's — names WHY
+        a hybridized block recompiled (the reference's silent-retrace
+        trap; SURVEY.md flags shape churn as the #1 TPU perf pathology)."""
+        if self._last_key is None:
+            return None
+        o_sig, o_train, o_rec, o_tracked = self._last_key
+        n_sig, n_train, n_rec, n_tracked = new_key
+        causes = []
+        if o_sig != n_sig:
+            if len(o_sig) != len(n_sig):
+                causes.append("arity")
+            else:
+                if any(o[0] != n[0] for o, n in zip(o_sig, n_sig)):
+                    causes.append("shape")
+                if any(o[1] != n[1] for o, n in zip(o_sig, n_sig)):
+                    causes.append("dtype")
+        if o_train != n_train:
+            causes.append("training")
+        if o_rec != n_rec:
+            causes.append("recording")
+        if o_tracked != n_tracked:
+            causes.append("inputs_tracked")
+        return "+".join(causes) or "unknown"
 
     def _build(self, args, arrays, handles, diff_mask, ctx, training, recording,
                inputs_tracked):

@@ -188,6 +188,18 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         estimator.net.save_parameters(path)
 
 
+def __getattr__(name):
+    # TelemetryHandler lives in observability/handlers.py (it is the
+    # telemetry subsystem's view of the estimator protocol) — re-exported
+    # here lazily so `from ...event_handler import TelemetryHandler`
+    # matches the reference handler import style without an import cycle.
+    if name == "TelemetryHandler":
+        from ....observability.handlers import TelemetryHandler
+
+        return TelemetryHandler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
     def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
                  baseline=None):

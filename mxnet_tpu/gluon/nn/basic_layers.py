@@ -8,6 +8,7 @@ so reference checkpoints map 1:1.
 
 from __future__ import annotations
 
+from ...base import MXNetError
 from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
@@ -125,6 +126,21 @@ class Dense(HybridBlock):
         self.weight.shape = (self._units, in_units)
 
     def hybrid_forward(self, F, x, weight, bias=None):
+        if getattr(self, "_tpu_nchw", False):
+            if getattr(x, "ndim", None) is None:
+                # Symbol: the layout can't be inspected, and skipping the
+                # restore would silently contract NHWC features against
+                # NCHW weights — refuse loudly (the pass's contract)
+                raise MXNetError(
+                    "symbolic forward of an optimize_for'd Dense is "
+                    "unsupported: input layout cannot be inferred from a "
+                    "Symbol")
+            if x.ndim == 4:
+                # NHWC fused interior: restore NCHW feature order so the
+                # implicit flatten (or last-axis contraction) matches
+                # NCHW-trained weights (mirrors Flatten's
+                # _tpu_nchw_flatten)
+                x = F.transpose(x, axes=(0, 3, 1, 2))
         out = F.FullyConnected(x, weight, bias, no_bias=bias is None,
                                num_hidden=self._units, flatten=self._flatten)
         if self.act is not None:
@@ -209,9 +225,18 @@ class BatchNorm(HybridBlock):
 
     def _effective_axis(self, x):
         """NHWC fused mode normalises the last axis of 4-D tensors;
-        2-D (post-Dense) inputs keep the configured axis."""
-        if getattr(self, "_tpu_nhwc", False) and x.ndim == 4:
-            return 3
+        2-D (post-Dense) inputs keep the configured axis. Symbol has no
+        ndim: refuse loudly — the converted conv emits NHWC symbolically,
+        so the configured axis would normalise H, silently wrong."""
+        if getattr(self, "_tpu_nhwc", False):
+            nd = getattr(x, "ndim", None)
+            if nd is None:
+                raise MXNetError(
+                    "symbolic forward of an optimize_for'd BatchNorm is "
+                    "unsupported: input layout cannot be inferred from a "
+                    "Symbol")
+            if nd == 4:
+                return 3
         return self._axis
 
     def infer_shape(self, x):
@@ -361,10 +386,16 @@ class Embedding(HybridBlock):
 
 class Flatten(HybridBlock):
     def hybrid_forward(self, F, x):
-        if getattr(self, "_tpu_nchw_flatten", False) and x.ndim == 4:
-            # NHWC fused interior: restore NCHW feature order so the
-            # flattened vector matches NCHW-trained downstream weights
-            x = F.transpose(x, axes=(0, 3, 1, 2))
+        if getattr(self, "_tpu_nchw_flatten", False):
+            if getattr(x, "ndim", None) is None:
+                raise MXNetError(
+                    "symbolic forward of an optimize_for'd Flatten is "
+                    "unsupported: input layout cannot be inferred from a "
+                    "Symbol")
+            if x.ndim == 4:
+                # NHWC fused interior: restore NCHW feature order so the
+                # flattened vector matches NCHW-trained downstream weights
+                x = F.transpose(x, axes=(0, 3, 1, 2))
         return F.flatten(x)
 
     def __repr__(self):

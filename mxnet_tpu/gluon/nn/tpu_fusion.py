@@ -189,8 +189,10 @@ def _agnostic_types():
     if not _AGNOSTIC:
         from . import activations, basic_layers
 
-        types = [basic_layers.Activation, basic_layers.Dense,
-                 basic_layers.Dropout, basic_layers.Flatten,
+        # Dense/Flatten are NOT here: they are layout-sensitive (implicit
+        # flatten over NHWC vs NCHW feature order) and convert_block
+        # handles them explicitly
+        types = [basic_layers.Activation, basic_layers.Dropout,
                  basic_layers.Lambda, basic_layers.HybridLambda]
         for name in ("LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish"):
             if hasattr(activations, name):
@@ -217,6 +219,13 @@ def convert_block(block):
         # (post-Dense) BNs keep their configured axis
         block._tpu_nhwc = True
         return True
+    if isinstance(block, basic_layers.Dense):
+        # Dense consuming a 4-D NHWC interior tensor (VGG/AlexNet-style
+        # conv->Dense without an explicit Flatten) must see NCHW feature
+        # order before the implicit flatten, or its weights — NCHW-
+        # trained — silently mismatch (ADVICE r5 medium)
+        block._tpu_nchw = True
+        return True
     if isinstance(block, conv_layers._Pooling):
         block._kwargs["layout"] = "NHWC"
         return True
@@ -232,10 +241,19 @@ def convert_block(block):
 class NCHWAdapter(object):
     """Callable façade keeping the external NCHW interface of a net whose
     interior was switched to NHWC. Forward transposes the input once;
-    4-D outputs are transposed back."""
+    4-D outputs — including each 4-D element of tuple/list outputs
+    (multi-feature-map nets) — are transposed back."""
 
     def __init__(self, net):
         self._net = net
+
+    @staticmethod
+    def _back(out):
+        from ...ndarray import op as F
+
+        if isinstance(out, NDArray) and out.ndim == 4:
+            return F.transpose(out, axes=(0, 3, 1, 2))
+        return out
 
     def __call__(self, x):
         from ...ndarray import op as F
@@ -243,9 +261,12 @@ class NCHWAdapter(object):
         if getattr(x, "ndim", 0) == 4:
             x = F.transpose(x, axes=(0, 2, 3, 1))
         out = self._net(x)
-        if isinstance(out, NDArray) and out.ndim == 4:
-            out = F.transpose(out, axes=(0, 3, 1, 2))
-        return out
+        if isinstance(out, (tuple, list)):
+            mapped = [self._back(o) for o in out]
+            if hasattr(out, "_fields"):  # namedtuple: positional fields
+                return type(out)(*mapped)
+            return type(out)(mapped)
+        return self._back(out)
 
     def __getattr__(self, name):  # delegate (collect_params, cast, ...)
         return getattr(self._net, name)

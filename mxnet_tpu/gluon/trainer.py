@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from .. import optimizer as opt
 from ..base import MXNetError
 from ..kvstore import create as _create_kvstore
@@ -111,6 +112,19 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Scale grads by 1/batch_size, aggregate across devices, update."""
+        if not _obs.ENABLED:
+            return self._step_impl(batch_size, ignore_stale_grad)
+        import time
+
+        t0 = time.perf_counter()
+        self._step_impl(batch_size, ignore_stale_grad)
+        t1 = time.perf_counter()  # span excludes the probe's device sync
+        # grad norm AFTER allreduce: the global gradient (forces one
+        # device sync per step — see docs/observability.md overhead notes)
+        gnorm = self._grad_norm()
+        _obs.record_trainer_step(t0, t1, gnorm)
+
+    def _step_impl(self, batch_size, ignore_stale_grad):
         if not self._kv_initialized:
             self._init_kvstore()
         if self._params_to_init:
@@ -118,6 +132,24 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _grad_norm(self):
+        """Global L2 norm of the aggregated gradients (telemetry gauge)."""
+        sq = []
+        for param in self._params:
+            if param.grad_req == "null" or param._data is None:
+                continue
+            try:
+                g = param.list_grad()[0].data
+            except Exception:
+                continue  # grad never attached: skip, don't break the step
+            sq.append(jnp.vdot(g, g).astype(jnp.float32))
+        if not sq:
+            return 0.0
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        return float(jnp.sqrt(total))
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -24,9 +24,10 @@ import numpy as _np
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..ndarray.ndarray import NDArray
 from .base import register_kvstore
-from .local import KVStoreLocal
+from .local import KVStoreLocal, _nd_nbytes
 
 _REDUCE = {"mesh": None, "fn": None}
 
@@ -53,6 +54,17 @@ def _global_allreduce(raw):
     """
     if jax.process_count() == 1:
         return raw
+    if _obs.ENABLED:
+        import time
+
+        t0 = time.perf_counter()
+        out = _global_allreduce_impl(raw)
+        _obs.record_allreduce(time.perf_counter() - t0, _nd_nbytes(raw))
+        return out
+    return _global_allreduce_impl(raw)
+
+
+def _global_allreduce_impl(raw):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = _reduce_mesh()
@@ -116,6 +128,8 @@ class KVStoreDistTPU(KVStoreLocal):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
+            if _obs.ENABLED:
+                _obs.KV_BARRIER_TOTAL.inc()
             multihost_utils.sync_global_devices(
                 f"mxtpu_kv_barrier_{self._barrier_count}")
             self._barrier_count += 1

@@ -11,9 +11,23 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _obs
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase, register_kvstore
+
+
+def _nd_nbytes(v) -> int:
+    """Payload bytes of one NDArray-like (0 when unknowable)."""
+    try:
+        return int(v.size) * v.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def _group_nbytes(value) -> int:
+    vs = value if isinstance(value, (list, tuple)) else [value]
+    return sum(_nd_nbytes(v) for v in vs)
 
 
 @jax.jit
@@ -70,6 +84,8 @@ class KVStoreLocal(KVStoreBase):
         k = self._key(key)
         if k not in self._store:
             raise MXNetError(f"key {key} has not been initialized")
+        if _obs.ENABLED:
+            _obs.record_kv("push", _group_nbytes(value))
         merged = self._reduce(k, self._compress(k, self._merge(value)))
         if self._updater is not None:
             self._updater(int(key) if k.isdigit() else k, merged, self._store[k])
@@ -111,6 +127,8 @@ class KVStoreLocal(KVStoreBase):
         k = self._key(key)
         stored = self._store[k]
         outs = out if isinstance(out, (list, tuple)) else [out]
+        if _obs.ENABLED:
+            _obs.record_kv("pull", _nd_nbytes(stored) * len(outs))
         for o in outs:
             o._set_data(self._place(stored.data, o))
 
@@ -137,8 +155,13 @@ class KVStoreLocal(KVStoreBase):
             self.push(key, value, priority)
         else:
             k = self._key(key)
+            if _obs.ENABLED:
+                _obs.record_kv("push", _group_nbytes(value))
+                _obs.record_kv("pushpull", 0)
             merged = self._reduce(k, self._compress(k, self._merge(value)))
             outs = out if isinstance(out, (list, tuple)) else [out]
+            if _obs.ENABLED:
+                _obs.record_kv("pull", _nd_nbytes(merged) * len(outs))
             for o in outs:
                 o._set_data(self._place(merged.data, o))
 
@@ -167,6 +190,17 @@ class KVStoreLocal(KVStoreBase):
             merged = [g[0] for g in groups]  # nothing to sum
         else:
             merged = _tree_sum_groups(tuple(tuple(g) for g in groups))
+        if _obs.ENABLED:
+            _obs.record_kv(
+                "push", sum(_nd_nbytes(x) for g in groups for x in g),
+                count=len(groups))
+            _obs.record_kv("pushpull", 0, count=len(groups))
+            _obs.record_kv(
+                "pull",
+                sum(_nd_nbytes(m)
+                    * (len(o) if isinstance(o, (list, tuple)) else 1)
+                    for m, o in zip(merged, outs)),
+                count=len(groups))
         for m, out in zip(merged, outs):
             os_ = out if isinstance(out, (list, tuple)) else [out]
             for o in os_:

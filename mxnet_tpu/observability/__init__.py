@@ -1,0 +1,291 @@
+"""``mxnet_tpu.observability`` — run-scoped runtime telemetry.
+
+The unified instrumentation substrate for the stack (the role MXNet 1.x
+gave its engine-integrated ``src/profiler/``): a metrics registry
+(Counter/Gauge/Histogram with labels), a ring-buffer event tracer with
+chrome://tracing + JSONL exporters, and Prometheus text exposition.
+
+Instrumented hot paths (each behind ONE ``ENABLED`` boolean check):
+
+- ``ops/dispatch.py`` — per-op dispatch count + wall time,
+- ``gluon/block.py::_CachedGraph`` — compile count, cache hits, trace
+  wall time, retrace-cause diagnosis,
+- ``kvstore/local.py`` / ``kvstore/dist.py`` — push/pull counts and
+  bytes, allreduce latency, barrier count,
+- ``gluon/trainer.py`` — step count/latency spans, grad-norm gauge,
+- ``engine.py::wait`` — sync-probe latency, relay vs native path.
+
+Switch: ``MXTPU_TELEMETRY=1`` at process start, or
+``observability.set_enabled(True)`` at runtime. Off by default: the
+disabled cost at every site is a single module-attribute boolean read.
+
+Quickstart::
+
+    import mxnet_tpu as mx
+    mx.observability.set_enabled(True)
+    ... train ...
+    print(mx.observability.summary())
+    print(mx.observability.dump_prometheus())
+    mx.observability.tracer().dump_chrome_trace("trace.json")
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from ..base import getenv
+from .metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    DEFAULT_BUCKETS,
+)
+from .tracing import Span, Tracer, load_jsonl  # noqa: F401
+
+#: THE switch. Hot paths read this module attribute and skip all
+#: recording when False. Seeded from MXTPU_TELEMETRY (default off).
+ENABLED = bool(getenv("MXTPU_TELEMETRY", False, dtype=bool))
+
+_REGISTRY = MetricsRegistry()
+_TRACER = Tracer()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def tracer() -> Tracer:
+    return _TRACER
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip telemetry at runtime; returns the previous state."""
+    global ENABLED
+    prev, ENABLED = ENABLED, bool(on)
+    return prev
+
+
+def enable():
+    set_enabled(True)
+
+
+def disable():
+    set_enabled(False)
+
+
+def reset():
+    """Clear every recorded metric value and all trace events."""
+    _REGISTRY.reset()
+    _TRACER.clear()
+
+
+def span(name, cat="default", **args) -> Span:
+    return _TRACER.span(name, cat=cat, **args)
+
+
+# ---------------------------------------------------------------------------
+# metric catalog (module-level singletons so instrumented sites pay no
+# registry lookup per record) — see docs/observability.md
+# ---------------------------------------------------------------------------
+
+OP_DISPATCH_TOTAL = _REGISTRY.counter(
+    "mxtpu_op_dispatch_total", "imperative op dispatches, by op name")
+OP_DISPATCH_SECONDS = _REGISTRY.counter(
+    "mxtpu_op_dispatch_seconds_total",
+    "wall time spent in op dispatch (async: excludes device time), by op")
+
+CACHEDOP_COMPILE_TOTAL = _REGISTRY.counter(
+    "mxtpu_cachedop_compile_total",
+    "CachedGraph builds (trace+compile), by block")
+CACHEDOP_CACHE_HITS = _REGISTRY.counter(
+    "mxtpu_cachedop_cache_hit_total",
+    "CachedGraph signature-cache hits, by block")
+CACHEDOP_TRACE_SECONDS = _REGISTRY.counter(
+    "mxtpu_cachedop_trace_seconds_total",
+    "wall time of CachedGraph build + first compiled call, by block")
+CACHEDOP_RETRACE_TOTAL = _REGISTRY.counter(
+    "mxtpu_cachedop_retrace_total",
+    "recompiles after the first, by block and cause key-diff")
+
+KV_PUSH_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_push_total", "kvstore push operations (per key)")
+KV_PUSH_BYTES = _REGISTRY.counter(
+    "mxtpu_kvstore_push_bytes_total", "gradient bytes entering aggregation")
+KV_PULL_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_pull_total", "kvstore pull operations (per key)")
+KV_PULL_BYTES = _REGISTRY.counter(
+    "mxtpu_kvstore_pull_bytes_total", "bytes written into pull outputs")
+KV_PUSHPULL_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_pushpull_total", "fused pushpull aggregations (per key)")
+KV_ALLREDUCE_SECONDS = _REGISTRY.histogram(
+    "mxtpu_kvstore_allreduce_seconds",
+    "dispatch latency of the global-mesh allreduce")
+KV_ALLREDUCE_BYTES = _REGISTRY.counter(
+    "mxtpu_kvstore_allreduce_bytes_total",
+    "payload bytes through the global-mesh allreduce")
+KV_BARRIER_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_barrier_total", "cross-process barrier entries")
+
+TRAINER_STEP_TOTAL = _REGISTRY.counter(
+    "mxtpu_trainer_step_total", "Trainer.step calls")
+TRAINER_STEP_SECONDS = _REGISTRY.histogram(
+    "mxtpu_trainer_step_seconds", "Trainer.step wall time")
+TRAINER_GRAD_NORM = _REGISTRY.gauge(
+    "mxtpu_trainer_grad_norm",
+    "global L2 norm of the (post-allreduce) gradients at the last step")
+
+ENGINE_WAIT_TOTAL = _REGISTRY.counter(
+    "mxtpu_engine_wait_total", "engine.wait sync probes, by path")
+ENGINE_WAIT_SECONDS = _REGISTRY.counter(
+    "mxtpu_engine_wait_seconds_total",
+    "wall time blocked in engine.wait, by path")
+
+PROFILE_COUNTER = _REGISTRY.gauge(
+    "mxtpu_profile_counter",
+    "user-defined profiler.ProfileCounter values, by counter name")
+
+
+# ---------------------------------------------------------------------------
+# hot-path record helpers (called only after an ENABLED check at the site)
+# ---------------------------------------------------------------------------
+
+def record_op_dispatch(name: str, dt: float):
+    """Per-op dispatch accounting (ops/dispatch.py seam)."""
+    key = (("op", name),)
+    v = OP_DISPATCH_TOTAL._values
+    v[key] = v.get(key, 0.0) + 1
+    s = OP_DISPATCH_SECONDS._values
+    s[key] = s.get(key, 0.0) + dt
+
+
+def record_kv(kind: str, nbytes: int, count: int = 1):
+    """kvstore traffic accounting: kind in {push, pull, pushpull}."""
+    if kind == "push":
+        tot, byt = KV_PUSH_TOTAL, KV_PUSH_BYTES
+    elif kind == "pull":
+        tot, byt = KV_PULL_TOTAL, KV_PULL_BYTES
+    else:
+        KV_PUSHPULL_TOTAL.inc(count)
+        return
+    tot.inc(count)
+    byt.inc(nbytes)
+
+
+def record_allreduce(dt: float, nbytes: int):
+    KV_ALLREDUCE_SECONDS.observe(dt)
+    KV_ALLREDUCE_BYTES.inc(nbytes)
+    _TRACER.record("kvstore.allreduce", cat="comms",
+                   ts=_time.perf_counter() - dt, dur=dt,
+                   args={"bytes": nbytes})
+
+
+def record_engine_wait(path: str, dt: float):
+    key = (("path", path),)
+    v = ENGINE_WAIT_TOTAL._values
+    v[key] = v.get(key, 0.0) + 1
+    s = ENGINE_WAIT_SECONDS._values
+    s[key] = s.get(key, 0.0) + dt
+
+
+def record_trainer_step(t0: float, t1: float, grad_norm=None):
+    """One Trainer.step: advances the tracer step, records the span."""
+    dt = t1 - t0
+    TRAINER_STEP_TOTAL.inc()
+    TRAINER_STEP_SECONDS.observe(dt)
+    if grad_norm is not None:
+        TRAINER_GRAD_NORM.set(grad_norm)
+    step = _TRACER.mark_step()
+    args = {"step": step}
+    if grad_norm is not None:
+        args["grad_norm"] = grad_norm
+    _TRACER.record("trainer.step", cat="trainer", ts=t0, dur=dt, args=args)
+
+
+def record_compile(block: str, dt: float, cause=None):
+    """One CachedGraph build (gluon/block.py)."""
+    CACHEDOP_COMPILE_TOTAL.inc(1, block=block)
+    CACHEDOP_TRACE_SECONDS.inc(dt, block=block)
+    if cause:
+        CACHEDOP_RETRACE_TOTAL.inc(1, block=block, cause=cause)
+    _TRACER.record(f"cachedop.compile[{block}]", cat="compile",
+                   ts=_time.perf_counter() - dt, dur=dt,
+                   args={"cause": cause or "first"})
+
+
+# ---------------------------------------------------------------------------
+# exporters / summaries
+# ---------------------------------------------------------------------------
+
+def dump_prometheus() -> str:
+    """Prometheus text exposition of the whole registry."""
+    return _REGISTRY.dump_prometheus()
+
+
+def dump_chrome_trace(path=None) -> str:
+    return _TRACER.dump_chrome_trace(path)
+
+
+def dump_jsonl(path=None) -> str:
+    return _TRACER.dump_jsonl(path)
+
+
+def summary() -> str:
+    """Human-readable snapshot of the key run metrics (the per-epoch
+    body logged by the estimator handler / callback hook)."""
+    lines = ["telemetry summary:"]
+    n_ops = OP_DISPATCH_TOTAL.total()
+    if n_ops:
+        top = sorted(OP_DISPATCH_SECONDS._values.items(),
+                     key=lambda kv: kv[1], reverse=True)[:5]
+        lines.append(f"  op dispatches: {int(n_ops)} "
+                     f"({OP_DISPATCH_SECONDS.total() * 1e3:.2f} ms dispatch)")
+        for key, secs in top:
+            name = dict(key).get("op", "?")
+            cnt = int(OP_DISPATCH_TOTAL._values.get(key, 0))
+            lines.append(f"    {name:<28}{cnt:>8} calls"
+                         f"{secs * 1e3:>12.3f} ms")
+    compiles = CACHEDOP_COMPILE_TOTAL.total()
+    if compiles or CACHEDOP_CACHE_HITS.total():
+        lines.append(
+            f"  cachedop: {int(compiles)} compiles, "
+            f"{int(CACHEDOP_CACHE_HITS.total())} cache hits, "
+            f"{CACHEDOP_TRACE_SECONDS.total() * 1e3:.1f} ms tracing, "
+            f"{int(CACHEDOP_RETRACE_TOTAL.total())} retraces")
+    if KV_PUSH_TOTAL.total() or KV_PULL_TOTAL.total() \
+            or KV_PUSHPULL_TOTAL.total():
+        lines.append(
+            f"  kvstore: {int(KV_PUSH_TOTAL.total())} pushes "
+            f"({int(KV_PUSH_BYTES.total())} B), "
+            f"{int(KV_PULL_TOTAL.total())} pulls "
+            f"({int(KV_PULL_BYTES.total())} B), "
+            f"{int(KV_PUSHPULL_TOTAL.total())} pushpulls, "
+            f"{int(KV_BARRIER_TOTAL.total())} barriers")
+    steps = TRAINER_STEP_TOTAL.total()
+    if steps:
+        mean_ms = TRAINER_STEP_SECONDS.sum() / max(steps, 1) * 1e3
+        lines.append(f"  trainer: {int(steps)} steps, "
+                     f"{mean_ms:.2f} ms/step mean, "
+                     f"last grad norm {TRAINER_GRAD_NORM.value():.4g}")
+    waits = ENGINE_WAIT_TOTAL.total()
+    if waits:
+        lines.append(
+            f"  engine.wait: {int(waits)} probes, "
+            f"{ENGINE_WAIT_SECONDS.total() * 1e3:.1f} ms blocked")
+    if len(lines) == 1:
+        lines.append("  (no events recorded)")
+    return "\n".join(lines)
+
+
+def __getattr__(name):
+    # TelemetryHandler subclasses the estimator's event mixins; loading it
+    # eagerly would cycle through gluon at package-import time.
+    if name == "TelemetryHandler":
+        from .handlers import TelemetryHandler
+
+        return TelemetryHandler
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,94 @@
+"""Training-loop integration: estimator event handler + callback hook.
+
+``TelemetryHandler`` plugs into ``gluon.contrib.estimator.Estimator``'s
+event-handler protocol (it must subclass the estimator mixins — dispatch
+is isinstance-based) and logs the :func:`observability.summary` body per
+epoch, tagging epoch spans into the tracer. The classic-``callback``
+counterpart for Module-style loops lives in ``mxnet_tpu.callback``
+(``TelemetryLogger``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+from ..gluon.contrib.estimator.event_handler import (
+    BatchEnd,
+    EpochBegin,
+    EpochEnd,
+    TrainBegin,
+    TrainEnd,
+)
+from . import (
+    OP_DISPATCH_TOTAL,
+    CACHEDOP_COMPILE_TOTAL,
+    KV_PUSH_BYTES,
+    KV_PULL_BYTES,
+)
+from . import summary, tracer
+from . import enabled as _enabled
+
+
+class TelemetryHandler(TrainBegin, EpochBegin, BatchEnd, EpochEnd, TrainEnd):
+    """Logs a per-epoch telemetry summary and emits epoch trace spans.
+
+    Parameters
+    ----------
+    logger : logging.Logger, optional
+        Destination (default: the ``"telemetry"`` logger, INFO level).
+    auto_enable : bool
+        Turn telemetry on at train_begin when it is off (default True) —
+        attaching the handler is the opt-in.
+    """
+
+    def __init__(self, logger=None, auto_enable=True):
+        self.logger = logger or logging.getLogger("telemetry")
+        self.auto_enable = auto_enable
+        self.current_epoch = 0
+        self._epoch_t0 = None
+        self._epoch_base = {}
+        self._batches = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        if self.auto_enable and not _enabled():
+            from . import set_enabled
+
+            set_enabled(True)
+        self.current_epoch = 0
+
+    def _snapshot(self):
+        return {
+            "ops": OP_DISPATCH_TOTAL.total(),
+            "compiles": CACHEDOP_COMPILE_TOTAL.total(),
+            "push_b": KV_PUSH_BYTES.total(),
+            "pull_b": KV_PULL_BYTES.total(),
+        }
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self._epoch_t0 = time.perf_counter()
+        self._epoch_base = self._snapshot()
+        self._batches = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self._batches += 1
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        dt = time.perf_counter() - (self._epoch_t0 or time.perf_counter())
+        cur, base = self._snapshot(), self._epoch_base
+        tracer().record(f"epoch[{self.current_epoch}]", cat="epoch",
+                        ts=time.perf_counter() - dt, dur=dt,
+                        args={"batches": self._batches})
+        self.logger.info(
+            "[Epoch %d] %d batches in %.2fs: +%d op dispatches, "
+            "+%d compiles, +%d B pushed, +%d B pulled",
+            self.current_epoch, self._batches, dt,
+            int(cur["ops"] - base.get("ops", 0)),
+            int(cur["compiles"] - base.get("compiles", 0)),
+            int(cur["push_b"] - base.get("push_b", 0)),
+            int(cur["pull_b"] - base.get("pull_b", 0)))
+        self.logger.info(summary())
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info(summary())

@@ -17,6 +17,7 @@ from __future__ import annotations
 import jax
 
 from .. import autograd, engine
+from .. import observability as _obs
 from .registry import OpDef, jitted
 
 
@@ -33,17 +34,25 @@ def _maybe_sync(res):
 def _run_timed(opdef, fn, raw):
     """Execute ``fn(*raw)``; with profiler aggregate stats on, block and
     attribute wall time to the op (reference: ``AggregateStats`` hooks in
-    the engine's operator execution path)."""
+    the engine's operator execution path). The same seam feeds the
+    observability registry per-op count/time when telemetry is on —
+    WITHOUT blocking (dispatch wall time only), so it is cheap enough to
+    leave on during training."""
     from .. import profiler
 
-    if not profiler.aggregate_enabled():
+    aggregate = profiler.aggregate_enabled()
+    if not (aggregate or _obs.ENABLED):
         return fn(*raw)
     import time
 
     t0 = time.perf_counter()
     res = fn(*raw)
-    engine.wait(res)
-    profiler.record_op(opdef.name, time.perf_counter() - t0)
+    dispatch_dt = time.perf_counter() - t0  # before any blocking wait:
+    if aggregate:                           # the telemetry metric stays
+        engine.wait(res)                    # dispatch-only either way
+        profiler.record_op(opdef.name, time.perf_counter() - t0)
+    if _obs.ENABLED:
+        _obs.record_op_dispatch(opdef.name, dispatch_dt)
     return res
 
 

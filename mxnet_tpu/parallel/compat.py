@@ -1,0 +1,18 @@
+"""jax API compatibility accessors for the parallel layer.
+
+One place to absorb upstream moves; every in-repo consumer (parallel
+submodules, bench.py, tools/bandwidth/measure.py) goes through here.
+"""
+
+from __future__ import annotations
+
+
+def get_shard_map():
+    """``jax.shard_map`` accessor — the API was promoted out of
+    ``jax.experimental``; older jax in some containers only has the
+    experimental path."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax: pre-promotion API
+        from jax.experimental.shard_map import shard_map
+    return shard_map

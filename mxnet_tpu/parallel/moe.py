@@ -77,7 +77,8 @@ def moe_apply(params, x, mesh=None, axis_name="ep", capacity_factor=1.5):
             raise MXNetError(
                 f"experts {E} must divide mesh axis {axis_name} "
                 f"({mesh.shape[axis_name]})")
-        from jax import shard_map
+        from .compat import get_shard_map
+        shard_map = get_shard_map()
 
         expert_out = shard_map(
             run_experts, mesh=mesh,

@@ -80,7 +80,8 @@ def pipeline_apply(stage_fn, stage_params, x, mesh, axis_name="pp",
             axis_name)
         return outputs
 
-    from jax import shard_map
+    from .compat import get_shard_map
+    shard_map = get_shard_map()
 
     spec_params = jax.tree_util.tree_map(
         lambda _: P(axis_name), stage_params)

@@ -43,7 +43,8 @@ def ring_attention(query, key, value, mesh, axis_name="sp", scale=None,
     across the axis. Returns the global (B, H, T, D) result with the same
     sharding. Jit-able; collectives lower to ICI ppermute.
     """
-    from jax import shard_map
+    from .compat import get_shard_map
+    shard_map = get_shard_map()
 
     if scale is None:
         scale = 1.0 / (query.shape[-1] ** 0.5)

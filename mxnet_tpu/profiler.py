@@ -137,18 +137,41 @@ Event = ProfileTask
 
 
 class ProfileCounter:
+    """Named user counter (reference: ``profiler::ProfileCounter``).
+
+    Backed by the observability metrics registry (gauge
+    ``mxtpu_profile_counter{name=...}``), so values show up in
+    ``observability.dump_prometheus()`` alongside the runtime metrics.
+    User-driven, so it records regardless of the MXTPU_TELEMETRY switch.
+    """
+
     def __init__(self, name, domain=None):
         self.name = name
-        self.value = 0
+        if domain is not None:
+            self.name = f"{getattr(domain, 'name', domain)}:{name}"
+
+    @property
+    def _gauge(self):
+        from . import observability
+
+        return observability.PROFILE_COUNTER
+
+    @property
+    def value(self):
+        return self._gauge.value(name=self.name)
+
+    @value.setter
+    def value(self, v):
+        self.set_value(v)
 
     def set_value(self, value):
-        self.value = value
+        self._gauge.set(value, name=self.name)
 
     def increment(self, delta=1):
-        self.value += delta
+        self._gauge.inc(delta, name=self.name)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self._gauge.inc(-delta, name=self.name)
 
 
 Counter = ProfileCounter

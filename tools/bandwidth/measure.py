@@ -49,7 +49,8 @@ def main():
 
     if args.kv_store == "psum":
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        from jax import shard_map
+        from mxnet_tpu.parallel.compat import get_shard_map
+        shard_map = get_shard_map()
 
         mesh = Mesh(np.array(jax.devices()), ("dp",))
         x = jax.device_put(

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Pretty-print a captured telemetry JSONL trace as an aggregate table.
+
+Takes the JSONL emitted by ``mxnet_tpu.observability.dump_jsonl()`` and
+renders the ``profiler.dumps``-style table (Name / Total Count /
+Time (ms) / Min / Max / Avg), aggregated per event name::
+
+    python tools/telemetry_report.py trace.jsonl
+    python tools/telemetry_report.py trace.jsonl --cat trainer --sort avg
+
+Pure stdlib on purpose — the report runs anywhere (CI artifact hosts,
+laptops without jax) and in milliseconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+COLUMNS = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
+           f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
+
+_SORTS = {
+    "total": lambda kv: kv[1][1],
+    "count": lambda kv: kv[1][0],
+    "min": lambda kv: kv[1][2],
+    "max": lambda kv: kv[1][3],
+    "avg": lambda kv: kv[1][1] / kv[1][0] if kv[1][0] else 0.0,
+    "name": lambda kv: kv[0],
+}
+
+
+def load_events(source):
+    """Parse JSONL text or a path into a list of event dicts."""
+    import os
+
+    if "\n" not in source and os.path.exists(source):
+        with open(source) as f:
+            source = f.read()
+    events = []
+    for ln, line in enumerate(source.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"line {ln}: not valid JSON ({e})")
+        if not isinstance(ev, dict) or "name" not in ev:
+            raise SystemExit(f"line {ln}: not a trace event object")
+        events.append(ev)
+    return events
+
+
+def aggregate(events, cat=None):
+    """name -> [count, total_ms, min_ms, max_ms] over duration events."""
+    agg = {}
+    for ev in events:
+        if cat and ev.get("cat") != cat:
+            continue
+        ms = float(ev.get("dur", 0.0)) / 1e3  # trace dur is microseconds
+        rec = agg.get(ev["name"])
+        if rec is None:
+            agg[ev["name"]] = [1, ms, ms, ms]
+        else:
+            rec[0] += 1
+            rec[1] += ms
+            rec[2] = min(rec[2], ms)
+            rec[3] = max(rec[3], ms)
+    return agg
+
+
+def render_table(events, cat=None, sort_by="total", ascending=False):
+    """The ``profiler.dumps(aggregate_stats=True)`` table, from a trace."""
+    agg = aggregate(events, cat=cat)
+    lines = ["Telemetry Trace Statistics:", COLUMNS]
+    key = _SORTS.get(sort_by, _SORTS["total"])
+    for name, (cnt, tot, mn, mx) in sorted(agg.items(), key=key,
+                                           reverse=not ascending):
+        lines.append(f"{name:<40}{cnt:>12}{tot:>14.4f}"
+                     f"{mn:>12.4f}{mx:>12.4f}{tot / cnt:>12.4f}")
+    if not agg:
+        lines.append("(no events)")
+    return "\n".join(lines)
+
+
+def render_steps(events):
+    """Per-step timeline of trainer.step spans, when present."""
+    steps = [ev for ev in events if ev.get("name") == "trainer.step"]
+    if not steps:
+        return ""
+    lines = ["", "Step timeline:",
+             f"{'Step':>6}{'Dur (ms)':>12}{'Grad norm':>14}"]
+    for ev in steps:
+        args = ev.get("args") or {}
+        gn = args.get("grad_norm")
+        lines.append(f"{args.get('step', '?'):>6}"
+                     f"{float(ev.get('dur', 0.0)) / 1e3:>12.3f}"
+                     f"{(f'{gn:.4g}' if gn is not None else '-'):>14}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Aggregate a mxnet_tpu telemetry JSONL trace")
+    ap.add_argument("trace", help="path to the JSONL file ('-' for stdin)")
+    ap.add_argument("--cat", default=None,
+                    help="only events of this category (e.g. trainer, "
+                         "compile, comms)")
+    ap.add_argument("--sort", default="total", choices=sorted(_SORTS),
+                    help="sort column (default: total)")
+    ap.add_argument("--ascending", action="store_true")
+    ap.add_argument("--steps", action="store_true",
+                    help="also print the per-step timeline")
+    args = ap.parse_args(argv)
+
+    source = sys.stdin.read() if args.trace == "-" else args.trace
+    events = load_events(source)
+    print(render_table(events, cat=args.cat, sort_by=args.sort,
+                       ascending=args.ascending))
+    if args.steps:
+        out = render_steps(events)
+        if out:
+            print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
