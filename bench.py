@@ -342,6 +342,89 @@ def bench_flash_attention(backend):
               step_ms=per_w * 1e3, window=W)
 
 
+def bench_train_step(backend):
+    """Idiomatic Gluon loop, eager vs fused (PR3 tentpole): the same
+    record->backward->step loop run (a) with MXTPU_FUSED_STEP off on a
+    non-hybridized net — per-op dispatch, per-param update — and (b)
+    hybridized with the fused fast path — O(1) XLA dispatches per step.
+    Also writes BENCH_pr3.json (the first entry in this repo's bench
+    trajectory)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, engine, fusedstep, gluon
+    from mxnet_tpu.gluon import nn
+
+    n_layers = int(os.environ.get("BENCH_TS_LAYERS", "6"))
+    width = int(os.environ.get("BENCH_TS_WIDTH",
+                               "256" if backend != "cpu" else "64"))
+    batch = int(os.environ.get("BENCH_TS_BATCH",
+                               "64" if backend != "cpu" else "16"))
+    steps = int(os.environ.get("BENCH_TS_STEPS",
+                               "100" if backend != "cpu" else "20"))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    X = mx.nd.array(np.random.RandomState(0).rand(batch, width)
+                    .astype(np.float32))
+    Y = mx.nd.array(np.random.RandomState(1).randint(0, 10, (batch,))
+                    .astype(np.float32))
+
+    def run(fused):
+        prev = fusedstep.set_enabled(fused)
+        try:
+            mx.random.seed(0)
+            net = nn.HybridSequential()
+            for _ in range(n_layers):
+                net.add(nn.Dense(width, activation="relu", in_units=width))
+            net.add(nn.Dense(10, in_units=width))
+            net.initialize(init=mx.initializer.Xavier())
+            if fused:
+                net.hybridize()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.05, "momentum": 0.9},
+                               kvstore=None)
+
+            def one():
+                with autograd.record():
+                    l = loss_fn(net(X), Y)
+                l.backward()
+                tr.step(batch)
+                return l
+
+            one()
+            engine.wait(one().data)  # warmup: compile fwd/bwd/update
+            t0 = time.perf_counter()
+            l = None
+            for _ in range(steps):
+                l = one()
+            engine.wait(l.data)
+            return steps / (time.perf_counter() - t0)
+        finally:
+            fusedstep.set_enabled(prev)
+
+    eager_sps = run(False)
+    fused_sps = run(True)
+    tag = f"mlp{n_layers}x{width}_bs{batch}_{backend}"
+    _emit(f"train_step_eager_{tag}", eager_sps, "steps/sec", None,
+          step_ms=1e3 / eager_sps, steps=steps)
+    _emit(f"train_step_fused_{tag}", fused_sps, "steps/sec", None,
+          step_ms=1e3 / fused_sps, steps=steps,
+          speedup_vs_eager=round(fused_sps / eager_sps, 3))
+    out_path = os.environ.get(
+        "BENCH_PR3_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_pr3.json"))
+    with open(out_path, "w") as f:
+        json.dump({"scenario": "train_step", "backend": backend,
+                   "config": {"layers": n_layers, "width": width,
+                              "batch": batch, "steps": steps},
+                   "eager_steps_per_sec": round(eager_sps, 2),
+                   "fused_steps_per_sec": round(fused_sps, 2),
+                   "fused_speedup": round(fused_sps / eager_sps, 3)}, f,
+                  indent=2)
+        f.write("\n")
+
+
 def bench_allreduce(backend):
     import jax
     import jax.numpy as jnp
@@ -424,6 +507,7 @@ def main():
         os.environ.get("BENCH_ONLY") else None
     suite = [("allreduce", bench_allreduce),
              ("flash_attention", bench_flash_attention),
+             ("train_step", bench_train_step),
              ("bert", bench_bert),
              ("resnet", bench_resnet)]  # resnet LAST: tail = headline
     global _EMIT_BUFFER

@@ -40,6 +40,7 @@ from . import io  # noqa: F401
 from . import recordio  # noqa: F401
 from . import image  # noqa: F401
 from . import callback  # noqa: F401
+from . import fusedstep  # noqa: F401
 from . import observability  # noqa: F401
 from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401

@@ -25,11 +25,31 @@ from ..ndarray.ndarray import NDArray
 from ..ops import quantization as qops
 
 
+_ZOO_FEATURE_TYPES = None
+
+
+def _zoo_feature_types():
+    """Model-zoo base classes whose ``forward`` is exactly
+    ``output(features(x))`` — the only shapes ``_walk`` may decompose."""
+    global _ZOO_FEATURE_TYPES
+    if _ZOO_FEATURE_TYPES is None:
+        from ..gluon.model_zoo import vision as _zoo
+
+        names = ("AlexNet", "DenseNet", "Inception3", "MobileNet",
+                 "MobileNetV2", "ResNetV1", "ResNetV2", "SqueezeNet", "VGG")
+        _ZOO_FEATURE_TYPES = tuple(
+            t for t in (getattr(_zoo, n, None) for n in names)
+            if isinstance(t, type))
+    return _ZOO_FEATURE_TYPES
+
+
 def _walk(block):
     """Flatten a block tree into a layer list (supported layers only).
-    Zoo-style feature-extractor nets (``.features`` + ``.output``, the
-    model_zoo convention) open into their two sub-trees; residual blocks
-    stay leaves (planned as composite stages)."""
+    Zoo feature-extractor nets (``.features`` + ``.output``) open into
+    their two sub-trees — but ONLY for the known model_zoo base classes,
+    whose forward is verbatim ``output(features(x))``. A custom block
+    that merely carries those attribute names may do anything in between
+    (ADVICE r5 #4), so it raises instead of silently changing math."""
     from ..gluon.nn import HybridSequential, Sequential
 
     if isinstance(block, (HybridSequential, Sequential)):
@@ -37,9 +57,17 @@ def _walk(block):
         for child in block._children.values():
             out.extend(_walk(child))
         return out
+    if isinstance(block, _zoo_feature_types()):
+        return _walk(block.features) + _walk(block.output)
     if hasattr(block, "features") and hasattr(block, "output") \
             and not hasattr(block, "body"):
-        return _walk(block.features) + _walk(block.output)
+        raise MXNetError(
+            f"quantize_net: {type(block).__name__} has .features/.output "
+            "but is not a known model_zoo architecture; decomposing it "
+            "could silently change its math (its forward may not be "
+            "output(features(x))). Quantize block.features / "
+            "block.output separately, or pass a supported container "
+            "(HybridSequential / model_zoo net).")
     return [block]
 
 

@@ -1,0 +1,92 @@
+"""Fused-train-step policy: one switch, one fallback funnel.
+
+The O(1)-dispatch training fast path (shared-residual CachedOp backward,
+bucketed gradient allreduce, generalized fused optimizer update — see
+docs/performance.md) is coordinated from here so the three layers agree:
+
+- ``ENABLED`` — THE switch, seeded from ``MXTPU_FUSED_STEP`` (default on).
+- ``DONATE`` — buffer donation inside the fast path's executables, seeded
+  from ``MXTPU_FUSED_DONATE`` (default on; a no-op on the CPU backend).
+- ``bucket_bytes()`` — target flat-bucket size for the kvstore gradient
+  allreduce, from ``MXTPU_BUCKET_BYTES`` (default 4 MiB).
+- ``log_fallback(site, reason)`` — every place the fast path declines a
+  model funnels through here: the reason is logged LOUDLY once per
+  (site, reason) and counted in the telemetry registry, so "why is my
+  step slow" is one grep (the fallback is never silent, and never wrong
+  answers — the general per-param path takes over).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from .base import getenv
+
+#: Master switch for the fused train step (block/kvstore/trainer fast
+#: paths). Flip at runtime with set_enabled(); hybridized blocks pick the
+#: change up on their next call (the flag is part of the CachedOp key).
+ENABLED = bool(getenv("MXTPU_FUSED_STEP", True, dtype=bool))
+
+#: Donate weight/optimizer-state/residual buffers to the fused
+#: executables (XLA reuses the memory in place). Off-switch for the
+#: retain_graph / aliased-output caveats in docs/performance.md.
+DONATE = bool(getenv("MXTPU_FUSED_DONATE", True, dtype=bool))
+
+_BUCKET_BYTES_DEFAULT = 4 << 20
+
+# NB: XLA:CPU does not implement donation, so on the CPU backend jax
+# warns "Some donated buffers were not usable" once per donated
+# executable — harmless there (the fast path is correct either way).
+# We deliberately do NOT install a process-global warnings filter: on a
+# real accelerator that warning flags a genuinely failed donation, and
+# user code must be able to see it. The test suite filters it locally
+# (tests/conftest.py).
+
+_logger = logging.getLogger("mxnet_tpu.fusedstep")
+_LOGGED: set = set()
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def set_enabled(on: bool) -> bool:
+    """Flip the fused step at runtime; returns the previous state."""
+    global ENABLED
+    prev, ENABLED = ENABLED, bool(on)
+    return prev
+
+
+def donate_enabled() -> bool:
+    return DONATE
+
+
+def bucket_bytes() -> int:
+    """Target gradient-bucket payload size (bytes)."""
+    return int(getenv("MXTPU_BUCKET_BYTES", _BUCKET_BYTES_DEFAULT,
+                      dtype=int))
+
+
+def log_fallback(site: str, reason: str):
+    """Record that ``site`` declined the fast path because of ``reason``.
+
+    Logged at WARNING once per (site, reason) per process — loud enough
+    to see, quiet enough to train through — and counted per-label in the
+    telemetry registry when telemetry is on.
+    """
+    from . import observability as _obs
+
+    if _obs.ENABLED:
+        _obs.FUSED_FALLBACK_TOTAL.inc(1, site=site, reason=reason)
+    key = (site, reason)
+    if key not in _LOGGED:
+        _LOGGED.add(key)
+        _logger.warning(
+            "fused step: %s falling back to the general path (%s); "
+            "set MXTPU_FUSED_STEP=0 to silence the fast path entirely",
+            site, reason)
+
+
+def reset_fallback_log():
+    """Forget which (site, reason) pairs were already logged (tests)."""
+    _LOGGED.clear()

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd
+from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from .. import random as _random
 from ..base import MXNetError
@@ -471,6 +472,9 @@ class _CachedGraph:
             training,
             recording,
             inputs_tracked,
+            # only recording entries differ under the fused step, so the
+            # flag keys them alone (flipping it never retraces inference)
+            recording and _fusedstep.ENABLED,
         )
         entry = self._cache.get(key)
         if entry is not None:
@@ -500,8 +504,8 @@ class _CachedGraph:
         trap; SURVEY.md flags shape churn as the #1 TPU perf pathology)."""
         if self._last_key is None:
             return None
-        o_sig, o_train, o_rec, o_tracked = self._last_key
-        n_sig, n_train, n_rec, n_tracked = new_key
+        o_sig, o_train, o_rec, o_tracked, o_fused = self._last_key
+        n_sig, n_train, n_rec, n_tracked, n_fused = new_key
         causes = []
         if o_sig != n_sig:
             if len(o_sig) != len(n_sig):
@@ -517,6 +521,8 @@ class _CachedGraph:
             causes.append("recording")
         if o_tracked != n_tracked:
             causes.append("inputs_tracked")
+        if o_fused != n_fused:
+            causes.append("fused_step")
         return "+".join(causes) or "unknown"
 
     def _build(self, args, arrays, handles, diff_mask, ctx, training, recording,
@@ -585,6 +591,8 @@ class _CachedGraph:
                 out_raws, mut_raws = fwd_compiled(
                     dp, ndp, [a.data for a in call_arrays], key
                 )
+                if _obs.ENABLED:
+                    _obs.record_xla_dispatch("cachedop_fwd")
                 for i, raw in zip(mutated_idx, mut_raws):
                     call_handles[i]._set_data(raw)
                 outs = [NDArray(r, ctx=call_ctx) for r in out_raws]
@@ -592,10 +600,21 @@ class _CachedGraph:
 
             return runner
 
-        # Recording path: forward runs the plain compiled executable NOW;
-        # backward is a separately-jitted VJP (residuals rematerialized
-        # inside — one extra fwd inside bwd; the fully-fused train step in
-        # gluon.Trainer avoids even that).
+        # Recording path, two variants keyed by MXTPU_FUSED_STEP:
+        #  - shared-residual fast path (default): forward computes
+        #    jax.vjp ONCE; the residuals cross the jit boundary as a
+        #    jax.tree_util.Partial pytree, so backward is one executable
+        #    REUSING them — no rematerialized forward inside backward.
+        #    Backward donates the residual buffers (XLA reuses the
+        #    activation memory); a retain_graph second backward recomputes
+        #    them with one extra forward call.
+        #  - legacy remat path (flag off): backward is a separately-jitted
+        #    VJP that re-runs the forward inside to rebuild residuals.
+        if _fusedstep.ENABLED:
+            return self._build_recording_shared(
+                pure_fn, assemble, single_box, mutated_idx, diff_mask,
+                diff_param_pos, inputs_tracked, block)
+
         bwd_box = [None]
 
         def get_bwd():
@@ -631,6 +650,8 @@ class _CachedGraph:
                    if not diff_mask[i]]
             input_raws = [a.data for a in call_arrays]
             out_raws, mut_raws = fwd_compiled(dp, ndp, input_raws, key)
+            if _obs.ENABLED:
+                _obs.record_xla_dispatch("cachedop_fwd")
             for i, raw in zip(mutated_idx, mut_raws):
                 call_handles[i]._set_data(raw)
             outs = [NDArray(r, ctx=call_ctx) for r in out_raws]
@@ -642,6 +663,8 @@ class _CachedGraph:
 
             def node_vjp(out_ct):
                 cts = list(out_ct) if isinstance(out_ct, (tuple, list)) else [out_ct]
+                if _obs.ENABLED:
+                    _obs.record_xla_dispatch("cachedop_bwd")
                 return get_bwd()(dp, ndp, input_raws, key, cts, mut_zero)
 
             node = autograd.TapeNode(node_vjp, tape_inputs, len(outs),
@@ -651,6 +674,111 @@ class _CachedGraph:
                 # pure forward as a function of the tracked inputs, for
                 # grad(create_graph=True): diff params first, then input
                 # arrays when tracked (matches tape_inputs order)
+                dp2 = list(tvals[:len(diff_param_pos)])
+                ir2 = list(tvals[len(diff_param_pos):]) if inputs_tracked \
+                    else input_raws
+                o, _m, _s = pure_fn(assemble(dp2, ndp), ir2, key)
+                return o
+
+            node._replay = (replay_fwd,
+                            dp + (input_raws if inputs_tracked else []))
+            node.out_arrays = outs
+            for k, o in enumerate(outs):
+                o._ag = (node, k)
+            return outs[0] if single_box[0] else outs
+
+        return runner
+
+    def _build_recording_shared(self, pure_fn, assemble, single_box,
+                                mutated_idx, diff_mask, diff_param_pos,
+                                inputs_tracked, block):
+        """Shared-residual recording path (the fused-step fast path):
+        ONE compiled forward returning (outputs, aux-mutations, vjp
+        residuals); ONE compiled backward consuming the residuals."""
+
+        @jax.jit
+        def fwd_vjp_compiled(diff_params, nondiff_params, input_raws, key):
+            if inputs_tracked:
+                def f(dp, ir):
+                    o, m, single = pure_fn(assemble(dp, nondiff_params),
+                                           ir, key)
+                    single_box[0] = single
+                    return o, m
+
+                (out_raws, mut_raws), vjp_fn = jax.vjp(
+                    f, diff_params, input_raws)
+            else:
+                def f(dp):
+                    o, m, single = pure_fn(assemble(dp, nondiff_params),
+                                           input_raws, key)
+                    single_box[0] = single
+                    return o, m
+
+                (out_raws, mut_raws), vjp_fn = jax.vjp(f, diff_params)
+            return out_raws, mut_raws, vjp_fn
+
+        bwd_box = [None]
+
+        def get_bwd(mut_avals):
+            if bwd_box[0] is None:
+
+                def bwd_fn(vjp_fn, out_cts):
+                    # aux (BN stats) outputs take zero cotangents, built
+                    # in-graph — no per-buffer eager zeros dispatch
+                    mut_ct = [jnp.zeros(s, d) for s, d in mut_avals]
+                    return vjp_fn((list(out_cts), mut_ct))
+
+                bwd_box[0] = jax.jit(
+                    bwd_fn,
+                    donate_argnums=(0,) if _fusedstep.DONATE else ())
+            return bwd_box[0]
+
+        def runner(call_args, call_arrays, call_handles, call_ctx):
+            key = _random._next_key()
+            dp = [call_handles[i].data for i in diff_param_pos]
+            ndp = [call_handles[i].data for i in range(len(call_handles))
+                   if not diff_mask[i]]
+            input_raws = [a.data for a in call_arrays]
+            out_raws, mut_raws, vjp_fn = fwd_vjp_compiled(
+                dp, ndp, input_raws, key)
+            if _obs.ENABLED:
+                _obs.record_xla_dispatch("cachedop_fwd")
+            for i, raw in zip(mutated_idx, mut_raws):
+                call_handles[i]._set_data(raw)
+            outs = [NDArray(r, ctx=call_ctx) for r in out_raws]
+
+            tape_inputs = [call_handles[i] for i in diff_param_pos]
+            if inputs_tracked:
+                tape_inputs = tape_inputs + list(call_arrays)
+            mut_avals = tuple((m.shape, m.dtype) for m in mut_raws)
+            res_box = [vjp_fn]
+
+            def node_vjp(out_ct):
+                cts = list(out_ct) if isinstance(out_ct, (tuple, list)) \
+                    else [out_ct]
+                vf = res_box[0]
+                if vf is None:
+                    # residuals were donated to an earlier backward
+                    # (retain_graph): rebuild them, one extra forward
+                    _, _, vf = fwd_vjp_compiled(dp, ndp, input_raws, key)
+                    if _obs.ENABLED:
+                        _obs.record_xla_dispatch("cachedop_fwd")
+                res_box[0] = vf if not _fusedstep.DONATE else None
+                grads = get_bwd(mut_avals)(vf, cts)
+                if _obs.ENABLED:
+                    _obs.record_xla_dispatch("cachedop_bwd")
+                if inputs_tracked:
+                    dp_ct, ir_ct = grads
+                    return list(dp_ct) + list(ir_ct)
+                (dp_ct,) = grads
+                return list(dp_ct)
+
+            node = autograd.TapeNode(node_vjp, tape_inputs, len(outs),
+                                     name=f"CachedOp[{block_name(block)}]")
+
+            def replay_fwd(*tvals):
+                # for grad(create_graph=True): same contract as the
+                # legacy path — diff params first, then tracked inputs
                 dp2 = list(tvals[:len(diff_param_pos)])
                 ir2 = list(tvals[len(diff_param_pos):]) if inputs_tracked \
                     else input_raws

@@ -4,6 +4,15 @@ Reference: ``python/mxnet/gluon/trainer.py`` (symbols ``Trainer.step``,
 ``_allreduce_grads``, ``_update``). Multi-device aggregation goes through
 the KVStore exactly as in the reference; on a TPU mesh the ``dist_tpu_sync``
 store lowers push/pull to an ICI allreduce (SURVEY.md §2.5 P2/P4).
+
+Fused update fast path (MXTPU_FUSED_STEP, default on): ONE jitted
+executable updates every parameter per step — the analog of the
+reference's multi-tensor ``multi_sgd``/``multi_mp_sgd`` kernels — with
+scheduled lr, ``clip_gradient`` and per-param ``lr_mult``/``wd_mult``
+passed as jit OPERANDS (not trace constants, so hyperparameter changes
+never retrace), weight and optimizer-state buffers donated to XLA, and
+the telemetry grad-norm gauge folded into the same executable (no
+per-step device sync). See docs/performance.md for eligibility.
 """
 
 from __future__ import annotations
@@ -11,6 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from .. import optimizer as opt
 from ..base import MXNetError
@@ -43,6 +53,8 @@ class Trainer:
         self._kvstore = None
         self._kv_initialized = False
         self._params_to_init = list(self._params)
+        self._fused = None  # fused-update plan cache (None = undecided)
+        self._fused_states = {}  # param name -> raw optimizer-state pytree
 
     def _check_contexts(self):
         contexts = None
@@ -88,16 +100,22 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
         remaining = []
+        initialized_any = False
         for param in self._params_to_init:
             if param._deferred_init is not None:
                 remaining.append(param)
                 continue
+            initialized_any = True
             if self._kvstore is not None and param._data is not None:
                 idx = self._param2idx[param.name]
                 self._kvstore.init(idx, param.list_data()[0])
         self._params_to_init = remaining
         if not self._contexts:
             self._contexts = self._check_contexts()
+        if initialized_any:
+            # new handles exist: any cached fused plan refers to the old
+            # ones (or to a "not eligible" verdict reached before init)
+            self._invalidate_fused()
 
     @property
     def learning_rate(self):
@@ -109,19 +127,28 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+        # lr rides into the fused executable as an OPERAND, so a valid
+        # plan needs no rebuild (per-step manual scheduling must not
+        # retrace); only a cached "not eligible" verdict is re-examined
+        if self._fused is False:
+            self._invalidate_fused()
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Scale grads by 1/batch_size, aggregate across devices, update."""
         if not _obs.ENABLED:
-            return self._step_impl(batch_size, ignore_stale_grad)
+            self._step_impl(batch_size, ignore_stale_grad)
+            return
         import time
 
         t0 = time.perf_counter()
-        self._step_impl(batch_size, ignore_stale_grad)
-        t1 = time.perf_counter()  # span excludes the probe's device sync
-        # grad norm AFTER allreduce: the global gradient (forces one
-        # device sync per step — see docs/observability.md overhead notes)
-        gnorm = self._grad_norm()
+        gnorm = self._step_impl(batch_size, ignore_stale_grad)
+        t1 = time.perf_counter()  # span excludes any probe device sync
+        if gnorm is None:
+            # eager update path: grad norm AFTER allreduce — forces one
+            # device sync per step (docs/observability.md overhead notes);
+            # the fused path computes it in-graph and hands back a LAZY
+            # device scalar instead, so there is no extra sync at all
+            gnorm = self._grad_norm()
         _obs.record_trainer_step(t0, t1, gnorm)
 
     def _step_impl(self, batch_size, ignore_stale_grad):
@@ -131,7 +158,7 @@ class Trainer:
             self._init_params()
         self._optimizer.rescale_grad = self._scale / batch_size
         self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        return self._update(ignore_stale_grad)
 
     def _grad_norm(self):
         """Global L2 norm of the aggregated gradients (telemetry gauge)."""
@@ -161,11 +188,17 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        keys, grads = [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
-            grads = param.list_grad()
-            self._kvstore.pushpull(i, grads, out=grads)
+            keys.append(i)
+            grads.append(param.list_grad())
+        if not keys:
+            return
+        # one multi-key pushpull: the store takes its bucketed (or
+        # grouped) fast path — O(1) dispatches instead of one per key
+        self._kvstore.pushpull(keys, grads, out=grads)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -176,72 +209,254 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     # -- fused update fast path ------------------------------------------
-    # One jitted executable updates every parameter per step (the analog of
-    # the reference's multi-tensor `multi_sgd` kernels) when the optimizer
-    # maps onto a pure pytree rule and every param lives on one device.
-    # (AdamW excluded: its decoupled wd differs from the shared adam rule)
+    # One jitted executable updates every parameter per step (the analog
+    # of the reference's multi-tensor `multi_sgd` kernels) when the
+    # optimizer maps onto a pure pytree rule and every param lives on one
+    # device. Scheduled lr, clip_gradient, rescale_grad and per-param
+    # lr_mult/wd_mult ride in as OPERANDS; momentum/betas stay trace
+    # constants. (AdamW excluded: its decoupled wd differs from the
+    # shared adam rule.)
     _FUSABLE = {"sgd": ("momentum", "wd"),
+                "nag": ("momentum", "wd"),
                 "adam": ("beta1", "beta2", "epsilon", "wd"),
                 "lamb": ("beta1", "beta2", "epsilon", "wd")}
 
+    def _invalidate_fused(self):
+        """Drop the cached fused plan (kept optimizer states survive in
+        ``_fused_states``); the next step re-runs eligibility."""
+        self._fused = None
+
     def _fused_setup(self):
-        if getattr(self, "_fused", None) is not None:
+        if self._fused is not None:
             return self._fused
-        self._fused = False
-        name = type(self._optimizer).__name__.lower()
+        active = [p for p in self._params if p.grad_req != "null"]
+        if not active or any(p._data is None or p._deferred_init is not None
+                             for p in active):
+            # some params not initialized yet (deferred init): decide
+            # LATER. Caching False here permanently disabled the fast
+            # path for models whose first forward had not run yet — and
+            # planning over the initialized SUBSET would silently skip
+            # the deferred params once they materialize.
+            return False
+        self._fused = self._build_fused_plan(active)
+        return self._fused
+
+    def _build_fused_plan(self, active):
         o = self._optimizer
-        if name not in self._FUSABLE or o.lr_scheduler is not None \
-                or o.clip_gradient is not None or o.multi_precision \
-                or o.lr_mult or o.wd_mult:
+        name = type(o).__name__.lower()
+
+        def no(reason):
+            _fusedstep.log_fallback("trainer", reason)
             return False
-        if any(len(p._data or {}) != 1 or p.lr_mult != 1.0 or p.wd_mult != 1.0
-               for p in self._params if p.grad_req != "null"):
-            return False
+
+        # (the MXTPU_FUSED_STEP switch is checked once, in
+        # _maybe_fused_update — a disabled flag never reaches here)
+        if name not in self._FUSABLE:
+            return no(f"optimizer '{name}' has no fused pytree rule")
+        if o.multi_precision:
+            return no("multi_precision uses the per-param master-weight path")
+        if name == "lamb" and (
+                getattr(o, "lower_bound", None) is not None
+                or getattr(o, "upper_bound", None) is not None
+                or not getattr(o, "bias_correction", True)):
+            return no("lamb with bounds/bias_correction=False")
+        if any(p._stype != "default" or p._grad_stype != "default"
+               for p in active):
+            return no("sparse parameters/gradients")
+        # real per-context count: a param replicated on >1 device updates
+        # via the update-once-broadcast path, not the fused executable
+        if any(len(p._data) != 1 for p in active):
+            return no("multi-device parameters")
+        handles = [p.data() for p in active]
+        grads = [h.grad for h in handles]
+        if any(g is None for g in grads):
+            return no("gradient buffers not attached")
+
         from ..parallel.spmd import _RULES
 
         hyper = {k: getattr(o, k) for k in self._FUSABLE[name]
                  if hasattr(o, k)}
         hyper["wd"] = o.wd
         rule_init, rule_update = _RULES[name](hyper)
+        idx = [self._param2idx[p.name] for p in active]
+        states = [self._restore_fused_state(name, p, i, h.data, rule_init)
+                  for p, i, h in zip(active, idx, handles)]
+        has_clip = o.clip_gradient is not None
+        # the in-graph grad-norm gauge reads the whole gradient set once
+        # more — only pay that when telemetry is on (toggling telemetry
+        # rebuilds the plan via the staleness guard)
+        with_gnorm = _obs.ENABLED
 
-        active = [p for p in self._params if p.grad_req != "null"
-                  and p._data is not None]
-        handles = [p.data() for p in active]
-        grads = [p.data().grad for p in active]
-        states = [rule_init(h.data) for h in handles]
-
-        @jax.jit
-        def fused(ws, gs, sts, lr, rescale):
-            new_ws, new_sts = [], []
-            for w, g, s in zip(ws, gs, sts):
-                w2, s2 = rule_update(
-                    w, g.astype(w.dtype) * rescale.astype(w.dtype), s,
-                    lr.astype(w.dtype))
+        def fused(ws, gs, sts, lr, wd, rescale, clip, lr_mults, wd_mults):
+            new_ws, new_sts, sq = [], [], []
+            for i, (w, g, s) in enumerate(zip(ws, gs, sts)):
+                if with_gnorm:
+                    g32 = g.astype(jnp.float32)
+                    sq.append(jnp.vdot(g32, g32))  # pre-rescale: parity
+                g = g * rescale.astype(g.dtype)    # with _grad_norm
+                if has_clip:
+                    c = clip.astype(g.dtype)
+                    g = jnp.clip(g, -c, c)
+                w2, s2 = rule_update(w, g, s, lr * lr_mults[i],
+                                     wd=wd * wd_mults[i])
                 new_ws.append(w2)
                 new_sts.append(s2)
-            return new_ws, new_sts
+            gnorm = jnp.sqrt(sum(sq)) if sq else jnp.float32(0.0)
+            return new_ws, new_sts, gnorm
 
-        self._fused = (fused, handles, grads, states, active)
-        return self._fused
+        fused_jit = jax.jit(
+            fused,
+            donate_argnums=(0, 2) if _fusedstep.DONATE else ())
+        return {"fn": fused_jit, "active": active, "handles": handles,
+                "grads": grads, "states": states, "idx": idx, "name": name,
+                "has_clip": has_clip, "mults": None,
+                "lr_mults": None, "wd_mults": None,
+                # freezing/unfreezing params (grad_req mutation) and a
+                # multi_precision toggle change WHICH params the plan
+                # covers — the staleness guard compares this signature
+                "req_sig": tuple(p.grad_req for p in self._params),
+                "multi_precision": o.multi_precision,
+                "with_gnorm": with_gnorm,
+                # trace CONSTANTS (momentum/betas/epsilon — wd is an
+                # operand): the per-step staleness guard compares these
+                # so direct attribute mutation rebuilds instead of
+                # silently using baked-in values
+                "static_hyper": {k: v for k, v in hyper.items()
+                                 if k != "wd"}}
+
+    def _restore_fused_state(self, name, p, idx, raw, rule_init):
+        """Optimizer state for one param: prefer the state a previous
+        fused plan left in ``_fused_states``; else migrate a per-param
+        eager state (``param._opt_state``); else a fresh init — so
+        flipping between paths or rebuilding the plan never resets
+        momentum."""
+        expected = rule_init(raw)
+        cached = self._fused_states.get(p.name)
+        if cached is not None and len(cached) == len(expected) and all(
+                getattr(c, "shape", None) == e.shape
+                and c.dtype == e.dtype for c, e in zip(cached, expected)):
+            return cached
+        st = getattr(p, "_opt_state", None)
+        o = self._optimizer
+        if st is not None:
+            # COPIES: the fused executable donates its state buffers, and
+            # aliasing the eager NDArray state would kill it. Ownership
+            # TRANSFERS to the fused path (the eager copy is deleted) so
+            # a later flip back never resurrects a stale state.
+            t = o._index_update_count.get(idx, o.begin_num_update)
+            migrated = None
+            if name in ("sgd", "nag") and len(expected) == 1 \
+                    and getattr(st, "shape", None) == expected[0].shape:
+                migrated = (jnp.copy(st.data).astype(expected[0].dtype),)
+            elif name in ("adam", "lamb") and isinstance(st, tuple) \
+                    and len(st) == 2:
+                m, v = st
+                if getattr(m, "shape", None) == expected[0].shape:
+                    migrated = (jnp.copy(m.data).astype(expected[0].dtype),
+                                jnp.copy(v.data).astype(expected[1].dtype),
+                                jnp.asarray(t, jnp.int32))
+            if migrated is not None:
+                del p._opt_state
+                return migrated
+        if name in ("adam", "lamb") and len(expected) == 3:
+            # fresh state: the bias-correction step count continues from
+            # the optimizer's counts (begin_num_update / prior eager
+            # steps), matching the eager path's t=_index_update_count
+            t0 = o._index_update_count.get(idx, o.begin_num_update)
+            if t0:
+                expected = (expected[0], expected[1],
+                            jnp.asarray(t0, jnp.int32))
+        return expected
+
+    def _migrate_fused_to_eager(self, param, idx, weight):
+        """Reverse migration: when the eager per-param path takes over
+        from the fused one (flag flipped, model turned ineligible), its
+        optimizer state seeds from the fused pytree state so momentum is
+        never silently reset. Ownership transfers (the fused copy is
+        dropped)."""
+        from ..ndarray.ndarray import NDArray
+
+        st = self._fused_states.pop(param.name, None)
+        if st is None:
+            return None
+        o = self._optimizer
+        name = type(o).__name__.lower()
+        wdt = weight.data.dtype
+        mk = lambda raw: NDArray(jnp.copy(raw).astype(wdt),  # noqa: E731
+                                 ctx=weight.ctx)
+        if name in ("sgd", "nag") and len(st) == 1:
+            return mk(st[0])
+        if name in ("adam", "lamb") and len(st) == 3:
+            m, v, t = st
+            o._index_update_count[idx] = max(
+                o._index_update_count.get(idx, o.begin_num_update), int(t))
+            return (mk(m), mk(v))
+        return None
 
     def _maybe_fused_update(self):
-        f = self._fused_setup()
-        if not f:
-            return False
-        fused, handles, grads, states, active = f
-        lr = jnp.asarray(self._optimizer.learning_rate, jnp.float32)
-        rescale = jnp.asarray(self._optimizer.rescale_grad, jnp.float32)
-        new_ws, new_sts = fused([h.data for h in handles],
-                                [g.data for g in grads], states, lr, rescale)
+        """Run the fused multi-tensor update; returns the in-graph grad
+        norm (lazy device scalar) on success, None on fallback."""
+        if not _fusedstep.ENABLED:
+            return None
+        plan = self._fused_setup()
+        if not plan:
+            return None
+        o = self._optimizer
+        # staleness guards (pure Python, no device work): hyperparameter
+        # shape changes or re-initialized params rebuild the plan
+        if ((o.clip_gradient is not None) != plan["has_clip"]
+                or type(o).__name__.lower() != plan["name"]
+                or _obs.ENABLED != plan["with_gnorm"]
+                or o.multi_precision != plan["multi_precision"]
+                or tuple(p.grad_req for p in self._params) != plan["req_sig"]
+                or any(getattr(o, k, None) != v
+                       for k, v in plan["static_hyper"].items())
+                or any(p._data is None or p.data() is not h or h.grad is not g
+                       for p, h, g in zip(plan["active"], plan["handles"],
+                                          plan["grads"]))):
+            self._invalidate_fused()
+            plan = self._fused_setup()
+            if not plan:
+                return None
+        # advance update counts exactly like the eager per-param path
+        for i in plan["idx"]:
+            o._index_update_count[i] = o._index_update_count.get(
+                i, o.begin_num_update) + 1
+            o.num_update = max(o.num_update, o._index_update_count[i])
+        mults = tuple((p.lr_mult, p.wd_mult) for p in plan["active"])
+        if mults != plan["mults"]:
+            plan["mults"] = mults
+            plan["lr_mults"] = jnp.asarray([m[0] for m in mults], jnp.float32)
+            plan["wd_mults"] = jnp.asarray([m[1] for m in mults], jnp.float32)
+        lr = jnp.asarray(o.learning_rate, jnp.float32)  # scheduler-aware
+        wd = jnp.asarray(o.wd, jnp.float32)
+        rescale = jnp.asarray(o.rescale_grad, jnp.float32)
+        clip = jnp.asarray(o.clip_gradient if plan["has_clip"] else 0.0,
+                           jnp.float32)
+        handles = plan["handles"]
+        new_ws, new_sts, gnorm = plan["fn"](
+            [h.data for h in handles], [g.data for g in plan["grads"]],
+            plan["states"], lr, wd, rescale, clip,
+            plan["lr_mults"], plan["wd_mults"])
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("trainer_fused")
         for h, w in zip(handles, new_ws):
             h._set_data(w)
-        self._fused = (fused, handles, grads, new_sts, active)
-        self._optimizer.num_update += 1
-        return True
+        plan["states"] = new_sts
+        for p, s in zip(plan["active"], new_sts):
+            self._fused_states[p.name] = s
+        return gnorm
 
     def _update(self, ignore_stale_grad=False):
-        if self._kvstore is None and self._maybe_fused_update():
-            return
+        gnorm = self._maybe_fused_update()
+        if gnorm is not None:
+            return gnorm
+        if isinstance(self._fused, dict):
+            # the eager loop below advances optimizer state the cached
+            # plan's `states` copies don't see — a later re-enable of the
+            # fast path must rebuild (and re-migrate states) or it would
+            # silently rewind momentum to the flip-off point
+            self._invalidate_fused()
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
@@ -250,19 +465,30 @@ class Trainer:
             # after allreduce every device holds the aggregated grad:
             # run the update once, broadcast the new weight
             if not hasattr(param, "_opt_state"):
-                param._opt_state = self._optimizer.create_state_multi_precision(
-                    i, datas[0]
-                )
+                param._opt_state = (
+                    self._migrate_fused_to_eager(param, i, datas[0])
+                    if param.name in self._fused_states else None)
+                if param._opt_state is None:
+                    param._opt_state = \
+                        self._optimizer.create_state_multi_precision(
+                            i, datas[0])
             self._optimizer.update_multi_precision(i, datas[0], grads[0],
                                                    param._opt_state)
             for d in datas[1:]:
                 d._set_data(datas[0].data)
+        return None
 
     def save_states(self, fname):
         import pickle
 
+        import numpy as _np
+
         states = {
             i: getattr(p, "_opt_state", None) for i, p in enumerate(self._params)
+        }
+        fused_states = {
+            name: tuple(_np.asarray(leaf) for leaf in st)
+            for name, st in self._fused_states.items()
         }
         with open(fname, "wb") as f:
             pickle.dump(
@@ -270,6 +496,7 @@ class Trainer:
                     "states": states,
                     "update_counts": self._optimizer._index_update_count,
                     "num_update": self._optimizer.num_update,
+                    "fused_states": fused_states,
                 },
                 f,
             )
@@ -282,5 +509,10 @@ class Trainer:
         for i, p in enumerate(self._params):
             if blob["states"].get(i) is not None:
                 p._opt_state = blob["states"][i]
+        self._fused_states = {
+            name: tuple(jnp.asarray(leaf) for leaf in st)
+            for name, st in blob.get("fused_states", {}).items()
+        }
         self._optimizer._index_update_count = blob["update_counts"]
         self._optimizer.num_update = blob["num_update"]
+        self._invalidate_fused()

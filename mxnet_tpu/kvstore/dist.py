@@ -124,6 +124,17 @@ class KVStoreDistTPU(KVStoreLocal):
             return NDArray(_global_allreduce(merged.data), ctx=merged.ctx)
         return merged
 
+    def _reduce_raw(self, raw):
+        """Bucketed path: one wire-speed AllReduce per flat gradient
+        bucket (vs per key in ``_reduce``) — the dispatch count per step
+        becomes O(num_buckets), constant in parameter count."""
+        if jax.process_count() > 1:
+            return _global_allreduce(raw)
+        return raw
+
+    def _reduce_raw_is_identity(self):
+        return jax.process_count() == 1
+
     def barrier(self):
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils

@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
@@ -54,6 +55,7 @@ class KVStoreLocal(KVStoreBase):
         self._updater = None
         self._optimizer = None
         self._opt_states = {}
+        self._bucket_plans = {}  # signature -> compiled bucket round-trip
 
     def _key(self, key):
         return str(key)
@@ -117,12 +119,9 @@ class KVStoreLocal(KVStoreBase):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
-            if out is not None and len(out) and isinstance(out[0], (list, tuple)):
-                for k, o in zip(key, out):
-                    self.pull(k, out=o, priority=priority)
-            else:
-                for k, o in zip(key, out):
-                    self.pull(k, out=o, priority=priority)
+            # per-key pull handles nested and flat ``out`` entries alike
+            for k, o in zip(key, out):
+                self.pull(k, out=o, priority=priority)
             return
         k = self._key(key)
         stored = self._store[k]
@@ -136,10 +135,13 @@ class KVStoreLocal(KVStoreBase):
         """Aggregate ``value`` across devices and broadcast into ``out``
         WITHOUT touching the stored weight (Trainer's allreduce path)."""
         if isinstance(key, (list, tuple)):
-            if (out is not None and self._updater is None
-                    and self._optimizer is None
-                    and getattr(self, "_compression", None) is None
-                    and self._grouped_pushpull(key, value, out)):
+            eligible = (out is not None and self._updater is None
+                        and self._optimizer is None
+                        and getattr(self, "_compression", None) is None)
+            if eligible and _fusedstep.ENABLED \
+                    and self._bucketed_pushpull(key, value, out):
+                return
+            if eligible and self._grouped_pushpull(key, value, out):
                 return
             for i, k in enumerate(key):
                 self.pushpull(k, value[i], out=None if out is None else out[i],
@@ -165,6 +167,31 @@ class KVStoreLocal(KVStoreBase):
             for o in outs:
                 o._set_data(self._place(merged.data, o))
 
+    @staticmethod
+    def _gather_groups(values):
+        """Normalize multi-key ``values`` into per-key raw-array tuples,
+        gathered to the first value's device (one jit call needs all its
+        operands on one device, like ``_merge`` does per key). Returns
+        None when a sparse value needs the general per-key path. Shared
+        by the grouped and bucketed fast paths so their eligibility and
+        device handling can never diverge."""
+        from ..ndarray.sparse import BaseSparseNDArray
+
+        nd_groups = []
+        for v in values:
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if any(isinstance(x, BaseSparseNDArray) for x in vs):
+                return None
+            nd_groups.append(vs)
+        # zero keys / empty per-key lists: the callers' loops all
+        # degenerate to no-ops, matching the old per-key behavior
+        dev = next((getattr(vs[0].data, "device", None)
+                    for vs in nd_groups if vs), None)
+        return tuple(
+            tuple(x.data if getattr(x.data, "device", None) == dev
+                  else jax.device_put(x.data, dev) for x in vs)
+            for vs in nd_groups)
+
     def _grouped_pushpull(self, keys, values, outs):
         """Batched multi-key aggregate: ONE jitted computation sums every
         key's device list (VERDICT r3 item 7 — per-key eager dispatch was
@@ -172,24 +199,15 @@ class KVStoreLocal(KVStoreBase):
         Returns False when shapes need the general per-key path."""
         if type(self)._reduce is not KVStoreLocal._reduce:
             return False  # dist subclasses psum inside _reduce per key
-        from ..ndarray.sparse import BaseSparseNDArray
-
-        # one jit call needs all operands on one device: gather like
-        # _merge does per key, to the first value's device
-        dev = None
-        groups = []
-        for v in values:
-            vs = v if isinstance(v, (list, tuple)) else [v]
-            if any(isinstance(x, BaseSparseNDArray) for x in vs):
-                return False
-            if dev is None:
-                dev = getattr(vs[0].data, "device", None)
-            groups.append([x.data if getattr(x.data, "device", None) == dev
-                           else jax.device_put(x.data, dev) for x in vs])
+        groups = self._gather_groups(values)
+        if groups is None:
+            return False
         if all(len(g) == 1 for g in groups):
             merged = [g[0] for g in groups]  # nothing to sum
         else:
-            merged = _tree_sum_groups(tuple(tuple(g) for g in groups))
+            merged = _tree_sum_groups(groups)
+            if _obs.ENABLED:
+                _obs.record_xla_dispatch("kv_grouped")
         if _obs.ENABLED:
             _obs.record_kv(
                 "push", sum(_nd_nbytes(x) for g in groups for x in g),
@@ -206,6 +224,136 @@ class KVStoreLocal(KVStoreBase):
             for o in os_:
                 o._set_data(self._place(m, o))
         return True
+
+    # -- bucketed multi-key pushpull (the fused-step allreduce path) -----
+    #
+    # Gradients are concatenated into a small number of fixed-size
+    # dtype-homogeneous flat buckets (target MXTPU_BUCKET_BYTES, default
+    # 4 MiB; built once per signature), reduced with ONE operation per
+    # bucket, and scattered back in-graph. In-process, pack+reduce+unpack
+    # fuse into a single executable; the dist store reduces each bucket
+    # with one global-mesh allreduce between a compiled pack and unpack —
+    # either way O(1) dispatches per step instead of O(num_keys).
+
+    def _bucketed_pushpull(self, keys, values, outs):
+        raw_groups = self._gather_groups(values)
+        if raw_groups is None:
+            _fusedstep.log_fallback(
+                "kvstore", "sparse gradients use the per-key path")
+            return False
+        if self._reduce_raw_is_identity() \
+                and all(len(vs) == 1 for vs in raw_groups):
+            # single device, nothing to reduce (in-process store, or a
+            # dist store running one process): pure identity — the
+            # grouped path short-circuits to a no-op, so a bucket
+            # pack/unpack round-trip would only ADD a dispatch and a
+            # full-gradient-set copy per step
+            return False
+        groups = raw_groups  # raw jax arrays: shape/dtype/nbytes below
+        sig = tuple((tuple(vs[0].shape), str(vs[0].dtype), len(vs))
+                    for vs in groups)
+        plan = self._bucket_plans.get(sig)
+        if plan is None:
+            plan = self._build_bucket_plan(sig)
+            self._bucket_plans[sig] = plan
+            if _obs.ENABLED:
+                _obs.KV_BUCKET_BUILD_TOTAL.inc()
+
+        if plan["fused"] is not None:
+            merged = plan["fused"](raw_groups)
+            n_dispatch = 1
+        else:
+            bucket_arrs = plan["pack"](raw_groups)
+            reduce_live = not self._reduce_raw_is_identity()
+            bucket_arrs = tuple(self._reduce_raw(b) for b in bucket_arrs)
+            merged = plan["unpack"](bucket_arrs)
+            n_dispatch = 2 + (len(bucket_arrs) if reduce_live else 0)
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("kv_bucket", n_dispatch)
+            _obs.KV_BUCKET_PUSHPULL_TOTAL.inc()
+            _obs.record_kv(
+                "push", sum(_nd_nbytes(x) for g in groups for x in g),
+                count=len(groups))
+            _obs.record_kv("pushpull", 0, count=len(groups))
+            _obs.record_kv(
+                "pull",
+                sum(_nd_nbytes(m)
+                    * (len(o) if isinstance(o, (list, tuple)) else 1)
+                    for m, o in zip(merged, outs)),
+                count=len(groups))
+        for m, out in zip(merged, outs):
+            os_ = out if isinstance(out, (list, tuple)) else [out]
+            for o in os_:
+                o._set_data(self._place(m, o))
+        return True
+
+    def _build_bucket_plan(self, sig):
+        """Greedy dtype-homogeneous packing of keys into ~bucket_bytes
+        flat buckets, plus the compiled pack/unpack for this signature."""
+        target = max(_fusedstep.bucket_bytes(), 1)
+        shapes = [s for s, _, _ in sig]
+        sizes = []
+        for shape, dtype, _ in sig:
+            n = 1
+            for d in shape:
+                n *= d
+            sizes.append(n)
+        buckets = []  # lists of key indices, concat order
+        open_per_dtype = {}  # dtype -> (bucket list, running bytes)
+        for ki, (shape, dtype, _) in enumerate(sig):
+            nbytes = sizes[ki] * jnp.dtype(dtype).itemsize
+            idxs, filled = open_per_dtype.get(dtype, (None, 0))
+            if idxs is None or (filled and filled + nbytes > target):
+                idxs, filled = [], 0
+                buckets.append(idxs)
+            idxs.append(ki)
+            open_per_dtype[dtype] = (idxs, filled + nbytes)
+
+        def pack(raw_groups):
+            out = []
+            for idxs in buckets:
+                parts = []
+                for ki in idxs:
+                    g = raw_groups[ki]
+                    s = g[0]
+                    for extra in g[1:]:
+                        s = s + extra  # cross-device tree-sum per key
+                    parts.append(s.reshape(-1))
+                out.append(parts[0] if len(parts) == 1
+                           else jnp.concatenate(parts))
+            return tuple(out)
+
+        def unpack(bucket_arrs):
+            raws = [None] * len(sig)
+            for bi, idxs in enumerate(buckets):
+                off = 0
+                for ki in idxs:
+                    n = sizes[ki]
+                    raws[ki] = jax.lax.slice(
+                        bucket_arrs[bi], (off,), (off + n,)
+                    ).reshape(shapes[ki])
+                    off += n
+            return tuple(raws)
+
+        if type(self)._reduce_raw is KVStoreLocal._reduce_raw:
+            # in-process reduction is identity: the whole round-trip is
+            # ONE executable (pack, sum, scatter all fused by XLA)
+            return {"fused": jax.jit(lambda g: unpack(pack(g))),
+                    "pack": None, "unpack": None, "buckets": buckets}
+        return {"fused": None, "pack": jax.jit(pack),
+                "unpack": jax.jit(unpack), "buckets": buckets}
+
+    def _reduce_raw(self, raw):
+        """Cross-process reduction of one flat gradient bucket: identity
+        in-process; the dist store overrides with the global-mesh
+        allreduce (the bucketed analog of per-key ``_reduce``)."""
+        return raw
+
+    def _reduce_raw_is_identity(self) -> bool:
+        """True when ``_reduce_raw`` does no work RIGHT NOW (the dist
+        override refines this per process count), so bucketing can skip
+        pure-identity aggregations."""
+        return type(self)._reduce_raw is KVStoreLocal._reduce_raw
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         from ..ndarray.sparse import RowSparseNDArray, retain_rows

@@ -131,6 +131,22 @@ KV_ALLREDUCE_BYTES = _REGISTRY.counter(
 KV_BARRIER_TOTAL = _REGISTRY.counter(
     "mxtpu_kvstore_barrier_total", "cross-process barrier entries")
 
+XLA_DISPATCH_TOTAL = _REGISTRY.counter(
+    "mxtpu_xla_dispatch_total",
+    "compiled-executable invocations, by site (op / cachedop_fwd / "
+    "cachedop_bwd / kv_grouped / kv_bucket / trainer_fused)")
+
+FUSED_FALLBACK_TOTAL = _REGISTRY.counter(
+    "mxtpu_fused_fallback_total",
+    "fused-train-step fast-path declines, by site and reason")
+
+KV_BUCKET_BUILD_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_bucket_build_total",
+    "gradient-bucket plans built (one per pushpull signature)")
+KV_BUCKET_PUSHPULL_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvstore_bucket_pushpull_total",
+    "bucketed multi-key pushpull aggregations (per call, not per key)")
+
 TRAINER_STEP_TOTAL = _REGISTRY.counter(
     "mxtpu_trainer_step_total", "Trainer.step calls")
 TRAINER_STEP_SECONDS = _REGISTRY.histogram(
@@ -161,6 +177,15 @@ def record_op_dispatch(name: str, dt: float):
     v[key] = v.get(key, 0.0) + 1
     s = OP_DISPATCH_SECONDS._values
     s[key] = s.get(key, 0.0) + dt
+    record_xla_dispatch("op")
+
+
+def record_xla_dispatch(site: str, count: int = 1):
+    """One compiled-executable invocation (jit call) at ``site`` — the
+    unit the dispatch-count regression tests assert O(1) per step on."""
+    key = (("site", site),)
+    v = XLA_DISPATCH_TOTAL._values
+    v[key] = v.get(key, 0.0) + count
 
 
 def record_kv(kind: str, nbytes: int, count: int = 1):
@@ -198,10 +223,16 @@ def record_trainer_step(t0: float, t1: float, grad_norm=None):
     TRAINER_STEP_TOTAL.inc()
     TRAINER_STEP_SECONDS.observe(dt)
     if grad_norm is not None:
-        TRAINER_GRAD_NORM.set(grad_norm)
+        # lazy: the fused step hands a device scalar; it syncs only when
+        # the gauge is read (value()/exposition), never per step
+        TRAINER_GRAD_NORM.set_lazy(grad_norm)
     step = _TRACER.mark_step()
     args = {"step": step}
-    if grad_norm is not None:
+    if isinstance(grad_norm, float):
+        # only plain floats go into the ring buffer: storing a lazy
+        # device scalar per event would pin one live device buffer per
+        # step for the lifetime of the 65536-event ring (the gauge above
+        # keeps the latest lazy value; trace events just omit it)
         args["grad_norm"] = grad_norm
     _TRACER.record("trainer.step", cat="trainer", ts=t0, dur=dt, args=args)
 

@@ -65,11 +65,13 @@ class Metric:
 
     # -- read side -------------------------------------------------------
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        # float() here is what makes set_lazy work: a device scalar
+        # stored by a gauge syncs at READ time, not on the hot path
+        return float(self._values.get(_label_key(labels), 0.0))
 
     def total(self) -> float:
         """Sum across every label set (test/summary convenience)."""
-        return sum(self._values.values())
+        return float(sum(self._values.values()))
 
     def labelsets(self):
         return [dict(k) for k in self._values]
@@ -109,6 +111,12 @@ class Gauge(Metric):
 
     def set(self, value: float, **labels):
         self._values[_label_key(labels)] = float(value)
+
+    def set_lazy(self, value, **labels):
+        """Store ``value`` without coercing to float: an asynchronous
+        device scalar (e.g. the fused step's in-graph grad norm) stays a
+        future until someone reads the gauge — recording never blocks."""
+        self._values[_label_key(labels)] = value
 
     def inc(self, amount: float = 1.0, **labels):
         key = _label_key(labels)

@@ -107,8 +107,10 @@ class Tracer:
     # -- exporters -------------------------------------------------------
     def dump_chrome_trace(self, path=None) -> str:
         """chrome://tracing JSON; written to ``path`` when given."""
+        # default=float: event args may hold asynchronous device scalars
+        # (the fused step's lazy grad norm) — sync them at dump time only
         body = json.dumps({"traceEvents": self.events(),
-                           "displayTimeUnit": "ms"})
+                           "displayTimeUnit": "ms"}, default=float)
         if path:
             with open(path, "w") as f:
                 f.write(body)
@@ -116,7 +118,8 @@ class Tracer:
 
     def dump_jsonl(self, path=None) -> str:
         """One JSON event per line; written to ``path`` when given."""
-        body = "\n".join(json.dumps(ev) for ev in self._events)
+        body = "\n".join(json.dumps(ev, default=float)
+                         for ev in self._events)
         if body:
             body += "\n"
         if path:

@@ -37,7 +37,14 @@ def _run_timed(opdef, fn, raw):
     the engine's operator execution path). The same seam feeds the
     observability registry per-op count/time when telemetry is on —
     WITHOUT blocking (dispatch wall time only), so it is cheap enough to
-    leave on during training."""
+    leave on during training.
+
+    Each eager op here is ONE compiled-executable invocation, so this
+    seam also feeds ``mxtpu_xla_dispatch_total{site="op"}`` (via
+    ``record_op_dispatch``) — the counter the fused-train-step
+    regression tests assert stays O(1) per step: a hybridized step
+    routes around this per-op path entirely (CachedOp fwd/bwd, bucketed
+    kvstore, fused update each count their own site)."""
     from .. import profiler
 
     aggregate = profiler.aggregate_enabled()

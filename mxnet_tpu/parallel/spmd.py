@@ -67,12 +67,15 @@ def _state_dtype(w):
 
 def _sgd_rule(hyper):
     mom = hyper.get("momentum", 0.0)
-    wd = hyper.get("wd", 0.0)
+    wd_const = hyper.get("wd", 0.0)
 
     def init(w):
         return (jnp.zeros(w.shape, _state_dtype(w)),) if mom else ()
 
-    def update(w, g, state, lr):
+    # ``wd`` defaults to the hyper constant but also accepts a traced
+    # scalar operand (gluon.Trainer's fused update passes per-param
+    # wd*wd_mult that way, so changing wd never retraces)
+    def update(w, g, state, lr, wd=wd_const):
         dt = _state_dtype(w)
         w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
         g32 = g32 + wd * w32
@@ -88,14 +91,14 @@ def _adam_rule(hyper):
     beta1 = hyper.get("beta1", 0.9)
     beta2 = hyper.get("beta2", 0.999)
     eps = hyper.get("epsilon", 1e-8)
-    wd = hyper.get("wd", 0.0)
+    wd_const = hyper.get("wd", 0.0)
 
     def init(w):
         dt = _state_dtype(w)
         return (jnp.zeros(w.shape, dt), jnp.zeros(w.shape, dt),
                 jnp.zeros((), jnp.int32))
 
-    def update(w, g, state, lr):
+    def update(w, g, state, lr, wd=wd_const):
         dt = _state_dtype(w)
         m, v, t = state
         t = t + 1
@@ -115,14 +118,14 @@ def _lamb_rule(hyper):
     beta1 = hyper.get("beta1", 0.9)
     beta2 = hyper.get("beta2", 0.999)
     eps = hyper.get("epsilon", 1e-6)
-    wd = hyper.get("wd", 0.0)
+    wd_const = hyper.get("wd", 0.0)
 
     def init(w):
         dt = _state_dtype(w)
         return (jnp.zeros(w.shape, dt), jnp.zeros(w.shape, dt),
                 jnp.zeros((), jnp.int32))
 
-    def update(w, g, state, lr):
+    def update(w, g, state, lr, wd=wd_const):
         dt = _state_dtype(w)
         m, v, t = state
         t = t + 1
@@ -144,12 +147,12 @@ def _lamb_rule(hyper):
 def _nag_rule(hyper):
     """Nesterov momentum, matching ``optimizer.NAG.update``."""
     mom = hyper.get("momentum", 0.0)
-    wd = hyper.get("wd", 0.0)
+    wd_const = hyper.get("wd", 0.0)
 
     def init(w):
         return (jnp.zeros(w.shape, _state_dtype(w)),) if mom else ()
 
-    def update(w, g, state, lr):
+    def update(w, g, state, lr, wd=wd_const):
         dt = _state_dtype(w)
         w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
         g32 = g32 + wd * w32

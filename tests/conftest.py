@@ -25,6 +25,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def pytest_configure(config):
+    # XLA:CPU has no buffer donation; the fused step donates anyway
+    # (no-op) and jax warns once per compiled function — pure noise here
+    config.addinivalue_line(
+        "filterwarnings",
+        "ignore:Some donated buffers were not usable")
+
+
 @pytest.fixture(autouse=True)
 def _seed_rngs():
     """Deterministic per-test RNG (reference: common.py:with_seed)."""
