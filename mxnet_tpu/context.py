@@ -33,6 +33,20 @@ def _accelerator_platform() -> str:
         return "cpu"
 
 
+_CACHE_WIRED = False
+
+
+def _wire_compile_cache():
+    """One-shot MXTPU_COMPILE_CACHE hookup, deferred to the first
+    Context so plain imports never touch jax config (and the flag keeps
+    Context.__init__ to one boolean check afterwards)."""
+    global _CACHE_WIRED
+    _CACHE_WIRED = True
+    from . import runtime
+
+    runtime.setup_compile_cache()
+
+
 class Context:
     """A device context. ``Context('tpu', 0)`` or ``Context(other_ctx)``."""
 
@@ -41,6 +55,8 @@ class Context:
     devstr2type = _DEVTYPE_TO_ID
 
     def __init__(self, device_type, device_id: int = 0):
+        if not _CACHE_WIRED:
+            _wire_compile_cache()
         if isinstance(device_type, Context):
             self.device_type, self.device_id = (
                 device_type.device_type,

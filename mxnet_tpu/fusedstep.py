@@ -67,6 +67,21 @@ def bucket_bytes() -> int:
                       dtype=int))
 
 
+_RETRACE_BUDGET_DEFAULT = 8
+
+
+def retrace_budget() -> int:
+    """Per-block budget of DISTINCT input-shape signatures a CachedGraph
+    may compile before the telemetry flags ``shape_wobble`` loudly
+    (``MXTPU_RETRACE_BUDGET``, default 8). Shape churn — partial last
+    batches, unbucketed variable-length text — silently multiplies
+    compile time and cache footprint; the budget turns that into one
+    grep-able warning + counter instead (docs/performance.md, "input
+    pipeline"). 0 disables the check."""
+    return int(getenv("MXTPU_RETRACE_BUDGET", _RETRACE_BUDGET_DEFAULT,
+                      dtype=int))
+
+
 def log_fallback(site: str, reason: str):
     """Record that ``site`` declined the fast path because of ``reason``.
 

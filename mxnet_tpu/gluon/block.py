@@ -339,6 +339,78 @@ class HybridBlock(Block):
 
         return _opt(self, backend=backend, strict=strict)
 
+    def warmup(self, shapes, dtype="float32", ctx=None, loss_fn=None,
+               trainer=None, label_shape=None, label_dtype="float32"):
+        """Pre-trace/compile this block's executables for a declared set
+        of input-shape buckets, so step 1 of training (or request 1 of
+        serving) runs at steady-state speed.
+
+        ``shapes``: one full input shape (batch dim included) or a list
+        of them — typically the shape-guard's bucket set, e.g.
+        ``[(64, 32), (64, 64), (64, 128)]`` for a ``SequenceBucketer``
+        with buckets ``[32, 64, 128]``.
+
+        With only ``shapes``, the inference forward is traced (predict
+        mode). Pass ``loss_fn`` to also trace the recording forward +
+        backward, and additionally ``trainer`` to trace the fused
+        optimizer update — the full fused train step. Parameter values,
+        gradients and optimizer state are snapshotted and restored, so
+        warmup never perturbs training state.
+
+        Pairs with ``MXTPU_COMPILE_CACHE``: a warm persistent cache
+        makes each pre-trace hit compiled XLA instead of compiling,
+        cutting cold-process startup to tracing time only. Returns the
+        number of variants traced.
+        """
+        import jax.numpy as jnp
+
+        from .. import engine as _engine
+        from ..context import current_context
+
+        if isinstance(shapes, (tuple, list)) and shapes and \
+                not isinstance(shapes[0], (tuple, list)):
+            shapes = [tuple(shapes)]  # one bare shape, tuple or list
+        ctx = ctx or current_context()
+        if trainer is not None and loss_fn is None:
+            raise MXNetError("warmup(trainer=...) requires loss_fn")
+
+        params = [p for _, p in sorted(self.collect_params().items())]
+        if any(p._data is None for p in params):
+            # resolve deferred init with one tiny eager pass (the first
+            # hybridized call runs eagerly anyway and would not compile)
+            x0 = NDArray(jnp.zeros(tuple(shapes[0]), dtype), ctx=ctx)
+            with autograd.predict_mode():
+                self(x0)
+            params = [p for _, p in sorted(self.collect_params().items())]
+
+        saved = _snapshot_training_state(params, trainer) \
+            if loss_fn is not None else None
+        try:
+            traced = 0
+            for shape in shapes:
+                x = NDArray(jnp.zeros(tuple(shape), dtype), ctx=ctx)
+                if loss_fn is None:
+                    with autograd.predict_mode():
+                        out = self(x)
+                    _engine.wait([o.data for o in out]
+                                 if isinstance(out, (list, tuple))
+                                 else out.data)
+                else:
+                    lshape = tuple(label_shape) if label_shape is not None \
+                        else (int(shape[0]),)
+                    y = NDArray(jnp.zeros(lshape, label_dtype), ctx=ctx)
+                    with autograd.record():
+                        loss = loss_fn(self(x), y)
+                    loss.backward()
+                    if trainer is not None:
+                        trainer.step(int(shape[0]))
+                    _engine.wait(loss.data)
+                traced += 1
+            return traced
+        finally:
+            if saved is not None:
+                _restore_training_state(params, trainer, saved)
+
     def infer_shape(self, *args):
         """Set shapes of this block's deferred params from input shapes.
 
@@ -441,6 +513,7 @@ class _CachedGraph:
         self._cache = {}
         self._params = None  # stable handle list, fixed order
         self._last_key = None  # previous signature, for retrace diagnosis
+        self._wobble_logged = False  # shape-wobble warned once per block
 
     def _param_handles(self, ctx):
         params = sorted(self.block.collect_params().items())
@@ -488,6 +561,7 @@ class _CachedGraph:
                             recording, inputs_tracked)
         self._cache[key] = entry
         self._last_key = key
+        self._check_retrace_budget()
         if not _obs.ENABLED:
             return entry(args, arrays, handles, ctx)
         try:
@@ -497,6 +571,35 @@ class _CachedGraph:
         finally:
             _obs.record_compile(block_name(self.block),
                                 time.perf_counter() - t0, cause)
+
+    def _check_retrace_budget(self):
+        """Shape-wobble guard (MXTPU_RETRACE_BUDGET): a block compiling
+        more DISTINCT input-shape signatures than the budget is almost
+        always an unstabilized input pipeline (partial last batches,
+        unbucketed sequence lengths) — each wobble is a full retrace of
+        forward AND backward. Flag it loudly once per block and count it
+        (``mxtpu_shape_wobble_total{block}``) instead of letting compile
+        time multiply silently."""
+        budget = _fusedstep.retrace_budget()
+        if budget <= 0:
+            return
+        n_shapes = len({k[0] for k in self._cache})
+        if n_shapes <= budget:
+            return
+        name = block_name(self.block)
+        if _obs.ENABLED:
+            _obs.SHAPE_WOBBLE_TOTAL.inc(1, block=name)
+        if not self._wobble_logged:
+            self._wobble_logged = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "shape_wobble: block %r has compiled %d distinct input-"
+                "shape signatures (budget %d, MXTPU_RETRACE_BUDGET). Pad "
+                "partial batches (DataLoader last_batch='pad') and bucket "
+                "variable-length inputs (gluon.data.SequenceBucketer) — "
+                "see docs/performance.md 'input pipeline'.",
+                name, n_shapes, budget)
 
     def _retrace_cause(self, new_key):
         """Diff the new signature against the previous call's — names WHY
@@ -793,6 +896,70 @@ class _CachedGraph:
             return outs[0] if single_box[0] else outs
 
         return runner
+
+
+def _copy_opt_state(st):
+    if st is None:
+        return None
+    if isinstance(st, (list, tuple)):
+        return type(st)(_copy_opt_state(s) for s in st)
+    if isinstance(st, NDArray):
+        return NDArray(jnp.copy(st.data), ctx=st.ctx)
+    return st
+
+
+def _snapshot_training_state(params, trainer):
+    """Deep-copy weights/grads/optimizer state before warmup steps run.
+    COPIES, not references: the fused step DONATES weight and state
+    buffers, so the arrays passed into a warmup step are dead
+    afterwards on a real accelerator."""
+    weights, grads, opt = [], [], []
+    for p in params:
+        hs = p.list_data() if p._data is not None else []
+        weights.append([jnp.copy(h.data) for h in hs])
+        try:
+            gl = p.list_grad() if p._data is not None else []
+        except Exception:
+            gl = []
+        grads.append([jnp.copy(g.data) for g in gl])
+        had = "_opt_state" in p.__dict__
+        opt.append((had, _copy_opt_state(p.__dict__.get("_opt_state"))))
+    saved = {"w": weights, "g": grads, "opt": opt}
+    if trainer is not None:
+        saved["fused"] = {
+            name: tuple(jnp.copy(leaf) for leaf in st)
+            for name, st in trainer._fused_states.items()}
+        saved["counts"] = dict(trainer._optimizer._index_update_count)
+        saved["num_update"] = trainer._optimizer.num_update
+    return saved
+
+
+def _restore_training_state(params, trainer, saved):
+    for p, ws, gs, (had, st) in zip(params, saved["w"], saved["g"],
+                                    saved["opt"]):
+        if p._data is None:
+            continue
+        for h, w in zip(p.list_data(), ws):
+            h._set_data(w)
+        try:
+            gl = p.list_grad()
+        except Exception:
+            gl = []
+        for h, g in zip(gl, gs):
+            h._set_data(g)
+        if had:
+            p._opt_state = st
+        elif "_opt_state" in p.__dict__:
+            del p._opt_state
+    if trainer is not None:
+        trainer._fused_states = saved["fused"]
+        trainer._optimizer._index_update_count = saved["counts"]
+        trainer._optimizer.num_update = saved["num_update"]
+        # the cached plan's `states` list advanced during warmup; rebuild
+        # from the restored _fused_states on the next real step (the
+        # executables themselves stay warm in jit/persistent caches)
+        trainer._invalidate_fused()
+    return None
 
 
 def block_name(b):

@@ -64,10 +64,27 @@ class Estimator:
             return batch[0], batch[1]
         return batch.data[0], batch.label[0]
 
+    def _prefetch_ctx(self):
+        """Device the prefetcher should stage batches onto: where the
+        model's parameters live (None -> host-side overlap only)."""
+        try:
+            for p in self.net.collect_params().values():
+                if p._data is not None:
+                    return p.list_ctx()[0]
+        except Exception:
+            pass
+        return None
+
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None):
         if epochs is None and batches is None:
             raise MXNetError("specify epochs or batches")
+        # stage batches onto the model's device from a background thread
+        # (MXTPU_DEVICE_PREFETCH deep, 0 disables) so the step never
+        # waits on batchify or the h2d transfer
+        from ...data.prefetcher import wrap_for_fit
+
+        train_data = wrap_for_fit(train_data, self._prefetch_ctx())
         handlers = list(event_handlers or [])
         handlers.append(StoppingHandler(epochs, batches))
         handlers.append(MetricHandler(self.train_metrics))

@@ -10,6 +10,7 @@ upload (PCIe->HBM) is the same single hop.
 
 from __future__ import annotations
 
+import logging
 import multiprocessing
 import os
 import pickle
@@ -19,6 +20,22 @@ import numpy as _np
 from ...context import cpu
 from ...ndarray.ndarray import NDArray, array as _array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+_logger = logging.getLogger(__name__)
+_PIN_MEMORY_WARNED = False
+
+
+def _warn_pin_memory_once():
+    """pin_memory is a host-allocator hint with no XLA/PJRT equivalent
+    (the runtime stages h2d through its own pinned buffers); warn ONCE
+    per process, not per loader or per batch."""
+    global _PIN_MEMORY_WARNED
+    if not _PIN_MEMORY_WARNED:
+        _PIN_MEMORY_WARNED = True
+        _logger.warning(
+            "DataLoader(pin_memory=True) is a no-op on the TPU/XLA "
+            "backend; use device=mx.tpu() (async device prefetch) to "
+            "overlap host->device transfer instead")
 
 
 def default_batchify_fn(data):
@@ -124,11 +141,20 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, device=None):
+        # __del__ must survive an __init__ that raised before the pool
+        # (or anything else) was assigned
+        self._worker_pool = None
         self._dataset = dataset
         self._pin_memory = pin_memory
+        if pin_memory:
+            _warn_pin_memory_once()
         self._thread_pool = thread_pool
         self._timeout = timeout
+        # device=ctx turns on the async device prefetcher: batches are
+        # converted + device_put N ahead from a background thread
+        # (gluon/data/prefetcher.py), so the step never waits on h2d
+        self._device = device
 
         if batch_sampler is None:
             if batch_size is None:
@@ -182,7 +208,7 @@ class DataLoader:
         else:
             self._batchify_fn = batchify_fn
 
-    def __iter__(self):
+    def _base_iter(self):
         if self._num_workers == 0:
 
             def same_process_iter():
@@ -196,9 +222,19 @@ class DataLoader:
             pin_memory=self._pin_memory, prefetch=self._prefetch,
             dataset=self._dataset if self._thread_pool else None)
 
+    def __iter__(self):
+        if self._device is None:
+            return self._base_iter()
+        from .prefetcher import DevicePrefetcher
+
+        # one prefetcher per epoch over a fresh single-use iterator; its
+        # __del__/close joins the staging thread when the epoch ends
+        return iter(DevicePrefetcher(self._base_iter(),
+                                     device=self._device))
+
     def __len__(self):
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._worker_pool is not None:
+        if getattr(self, "_worker_pool", None) is not None:
             self._worker_pool.terminate()

@@ -1,0 +1,262 @@
+"""Async device prefetcher: overlap host batch production and the
+host->device transfer with step compute.
+
+No direct reference counterpart (the reference's ``PrefetchingIter``
+overlaps host iterators only; device upload stayed synchronous inside
+the training step). TPU-native design: a background thread pulls
+batches from ANY source iterable (``gluon.data.DataLoader``, a legacy
+``io.DataIter``, a generator), converts them to device-committed
+arrays — ``jax.device_put`` onto one device, or sharded across a
+data-parallel mesh via ``parallel.spmd.shard_batch`` — and stages them
+in a bounded queue ``MXTPU_DEVICE_PREFETCH`` batches ahead (default 2:
+double buffering). The consumer's ``next()`` then returns an
+already-resident batch, so the accelerator never idles on batchify or
+PCIe/ICI while the previous step runs.
+
+Wired in automatically: ``DataLoader(..., device=mx.tpu())``, the
+estimator ``fit`` loop and ``Module.fit`` (both prefetch to the model's
+context unless ``MXTPU_DEVICE_PREFETCH=0``).
+
+Error contract: an exception raised by the source (or the transfer)
+propagates to the consumer's ``next()`` — never a silent hang — and
+``close()`` is idempotent and joins the thread (also via ``__del__``).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as _np
+
+from ... import observability as _obs
+from ...base import getenv
+from ...context import Context
+from ...ndarray.ndarray import NDArray
+
+_DEPTH_DEFAULT = 2
+
+
+def prefetch_depth() -> int:
+    """Queue depth (batches staged ahead) from ``MXTPU_DEVICE_PREFETCH``
+    (default 2 = double buffering; 0 disables auto-wrapping)."""
+    return max(0, int(getenv("MXTPU_DEVICE_PREFETCH", _DEPTH_DEFAULT,
+                             dtype=int)))
+
+
+def _leaf_nbytes(raw) -> int:
+    try:
+        return int(raw.size * raw.dtype.itemsize)
+    except Exception:
+        return 0
+
+
+class DevicePrefetcher:
+    """Wrap a batch source; stage converted batches N ahead on device.
+
+    >>> loader = DataLoader(dataset, batch_size=64, last_batch="pad")
+    >>> for x, y in DevicePrefetcher(loader, device=mx.tpu()):
+    ...     train_step(x, y)   # x, y already resident on device
+
+    ``device``: a Context (or None to keep batches on host — the
+    conversion/batchify work still overlaps). ``mesh``: shard each
+    batch's leading axis across the mesh's ``batch_axis`` instead
+    (multi-device SPMD feeding). Batch structure (tuple/list/dict/
+    ``DataBatch``) is preserved leaf-wise.
+    """
+
+    def __init__(self, source, device=None, mesh=None, depth=None,
+                 batch_axis="dp"):
+        if device is not None and mesh is not None:
+            raise ValueError("pass device OR mesh, not both")
+        self._source = source
+        self._device = device
+        self._mesh = mesh
+        self._batch_axis = batch_axis
+        self._depth = max(1, depth if depth is not None
+                          else (prefetch_depth() or _DEPTH_DEFAULT))
+        self._queue = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._exhausted = False
+
+    # -- conversion -------------------------------------------------------
+    def _jax_device(self):
+        if isinstance(self._device, Context):
+            return self._device.jax_device
+        return self._device  # already a jax.Device (or None)
+
+    def _convert_leaf(self, obj, nbytes_box):
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(self._convert_leaf(o, nbytes_box) for o in obj)
+        if isinstance(obj, dict):
+            return {k: self._convert_leaf(v, nbytes_box)
+                    for k, v in obj.items()}
+        if obj.__class__.__name__ == "DataBatch" and hasattr(obj, "data"):
+            from ...io.io import DataBatch
+
+            return DataBatch(
+                data=self._convert_leaf(obj.data, nbytes_box),
+                label=self._convert_leaf(obj.label, nbytes_box),
+                pad=obj.pad, index=obj.index, bucket_key=obj.bucket_key,
+                provide_data=obj.provide_data,
+                provide_label=obj.provide_label)
+        if isinstance(obj, NDArray):
+            raw = obj.data
+        elif isinstance(obj, _np.ndarray):
+            raw = obj
+        else:
+            return obj  # scalars / strings ride through untouched
+        nbytes_box[0] += _leaf_nbytes(raw)
+        if self._mesh is not None:
+            from ...parallel.spmd import shard_batch
+
+            placed = shard_batch(raw, self._mesh, self._batch_axis)
+            return NDArray(placed,
+                           ctx=obj.ctx if isinstance(obj, NDArray) else None)
+        import jax
+
+        dev = self._jax_device()
+        placed = jax.device_put(raw, dev) if dev is not None \
+            else (raw if isinstance(raw, jax.Array)
+                  else jax.numpy.asarray(raw))
+        ctx = self._device if isinstance(self._device, Context) else \
+            (obj.ctx if isinstance(obj, NDArray) else None)
+        return NDArray(placed, ctx=ctx)
+
+    def _stage(self, batch):
+        nbytes_box = [0]
+        t0 = time.perf_counter()
+        out = self._convert_leaf(batch, nbytes_box)
+        if _obs.ENABLED:
+            _obs.record_h2d(nbytes_box[0], time.perf_counter() - t0,
+                            self._queue.qsize())
+        return out
+
+    # -- producer ---------------------------------------------------------
+    def _produce(self, q, stop):
+        def put(item):
+            # bounded put that aborts promptly on close(): never leaves
+            # the thread blocked on a full queue nobody will drain
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for batch in self._source:
+                if stop.is_set():
+                    return
+                if not put(("ok", self._stage(batch))):
+                    return
+            put(("end", None))
+        except BaseException as e:  # propagate to the consumer's next()
+            put(("err", e))
+
+    def _start_epoch(self):
+        self.close()
+        if self._exhausted and hasattr(self._source, "reset"):
+            self._source.reset()
+        self._exhausted = False
+        self._stop = threading.Event()
+        self._queue = queue.Queue(maxsize=self._depth)
+        self._thread = threading.Thread(
+            target=self._produce, args=(self._queue, self._stop),
+            name="mxtpu-device-prefetch", daemon=True)
+        self._thread.start()
+
+    # -- consumer protocol ------------------------------------------------
+    def __iter__(self):
+        # iterator protocol: iter() on an IN-FLIGHT epoch returns self
+        # untouched (list(it)/enumerate(it) re-invoke iter and must not
+        # restart — close() would silently drop the staged batches); a
+        # fresh or exhausted wrapper starts the next epoch
+        if self._thread is None or self._exhausted:
+            self._start_epoch()
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            # stay exhausted until iter()/reset(), like any iterator —
+            # auto-restarting here would hand duplicated batches to a
+            # consumer draining past the epoch end
+            raise StopIteration
+        if self._thread is None:
+            self._start_epoch()
+        t0 = time.perf_counter()
+        kind, payload = self._queue.get()
+        if _obs.ENABLED:
+            _obs.DATA_PREFETCH_WAIT_SECONDS.inc(time.perf_counter() - t0)
+            _obs.DATA_PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
+        if kind == "ok":
+            return payload
+        self._exhausted = True
+        self.close()
+        if kind == "err":
+            raise payload
+        raise StopIteration
+
+    def next(self):
+        return self.__next__()
+
+    def __len__(self):
+        return len(self._source)
+
+    def __getattr__(self, name):
+        # transparent wrapper: provide_data / provide_label / batch_size /
+        # ... fall through to the source (DataIter protocol consumers)
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.__dict__["_source"], name)
+
+    def reset(self):
+        """DataIter-protocol reset: stop the in-flight epoch, reset the
+        source (when it supports it), arm a fresh epoch."""
+        self.close()
+        if hasattr(self._source, "reset"):
+            self._source.reset()
+        self._exhausted = False
+
+    def close(self):
+        """Idempotent shutdown: unblock and join the producer thread."""
+        thread = self.__dict__.get("_thread")
+        if thread is None:
+            return
+        self._stop.set()
+        q = self._queue
+        while True:  # drain so a producer blocked on put() wakes up
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=5.0)
+        self._thread = None
+        self._queue = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def wrap_for_fit(source, ctx=None, depth=None):
+    """Auto-wrap a fit-loop's train data in a DevicePrefetcher (the
+    estimator / ``Module.fit`` integration seam). Returns ``source``
+    unchanged when prefetch is disabled (``MXTPU_DEVICE_PREFETCH=0``)
+    or already wrapped."""
+    d = depth if depth is not None else prefetch_depth()
+    if d <= 0 or isinstance(source, DevicePrefetcher):
+        return source
+    if getattr(source, "_device", None) is not None \
+            or getattr(source, "_mesh", None) is not None:
+        # e.g. DataLoader(device=...): it already prefetches to device —
+        # stacking a second wrapper would stage (and count in telemetry)
+        # every batch twice
+        return source
+    device = ctx if isinstance(ctx, Context) else None
+    return DevicePrefetcher(source, device=device, depth=d)

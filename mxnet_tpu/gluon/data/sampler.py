@@ -47,7 +47,10 @@ class BatchSampler(Sampler):
 
     def __iter__(self):
         batch, self._prev = self._prev, []
+        head = []  # first batch_size indices, for "pad" wrap-around
         for i in self._sampler:
+            if len(head) < self._batch_size:
+                head.append(i)
             batch.append(i)
             if len(batch) == self._batch_size:
                 yield batch
@@ -59,17 +62,28 @@ class BatchSampler(Sampler):
                 return
             elif self._last_batch == "rollover":
                 self._prev = batch
+            elif self._last_batch == "pad":
+                # shape-stable epochs (NDArrayIter last_batch_handle=
+                # "pad" semantics): wrap indices from the epoch start so
+                # the final batch is full and nothing downstream
+                # retraces; wraps repeat when the dataset is shorter
+                # than one batch
+                while len(batch) < self._batch_size:
+                    batch.extend(head[:self._batch_size - len(batch)])
+                yield batch
             else:
-                raise ValueError(f"last_batch must be keep/discard/rollover, got {self._last_batch}")
+                raise ValueError("last_batch must be keep/discard/rollover/"
+                                 f"pad, got {self._last_batch}")
 
     def __len__(self):
-        if self._last_batch == "keep":
+        if self._last_batch in ("keep", "pad"):
             return (len(self._sampler) + self._batch_size - 1) // self._batch_size
         if self._last_batch == "discard":
             return len(self._sampler) // self._batch_size
         if self._last_batch == "rollover":
             return (len(self._prev) + len(self._sampler)) // self._batch_size
-        raise ValueError(f"last_batch must be keep/discard/rollover, got {self._last_batch}")
+        raise ValueError("last_batch must be keep/discard/rollover/pad, "
+                         f"got {self._last_batch}")
 
 
 class IntervalSampler(Sampler):

@@ -1,0 +1,141 @@
+"""Batch-shape stabilization: pad partial batches, bucket variable
+lengths.
+
+Reference analog: ``io.py`` ``last_batch_handle="pad"`` (NDArrayIter)
+and GluonNLP's ``FixedBucketSampler`` — unified here because on XLA a
+shape wobble is not a correctness detail but a COMPILE event: every
+distinct input signature retraces the CachedOp forward/backward and the
+fused train step (SURVEY.md flags shape churn as the #1 TPU perf
+pathology). The guard keeps the shape set small and known:
+
+- :func:`pad_batch` pads a partial final batch up to ``batch_size`` and
+  returns the validity mask, so metrics/losses can exclude the pad rows
+  exactly (parity with ``last_batch="discard"`` on the valid rows);
+- :class:`SequenceBucketer` pads variable-length sequences to a small
+  fixed set of lengths, bounding the executable count at
+  ``len(buckets)``;
+- the per-block retrace budget (``MXTPU_RETRACE_BUDGET``, enforced in
+  ``gluon/block.py``) flags ``shape_wobble`` loudly when the shape set
+  grows past what padding/bucketing should allow.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError, check_shape
+from ...ndarray.ndarray import NDArray
+
+
+def _pad_leaf(arr, batch_size):
+    """Pad ``arr``'s leading axis to ``batch_size`` by repeating its
+    first row (finite values — safe under any loss once masked)."""
+    n = arr.shape[0]
+    if n == batch_size:
+        return arr
+    if n > batch_size:
+        raise MXNetError(
+            f"pad_batch: batch of {n} rows exceeds batch_size {batch_size}")
+    if n == 0:
+        raise MXNetError("pad_batch: cannot pad an empty batch")
+    reps = (batch_size - n,) + (1,) * (arr.ndim - 1)
+    if isinstance(arr, NDArray):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.concatenate(
+            [arr.data, jnp.tile(arr.data[:1], reps)]), ctx=arr.ctx)
+    return _np.concatenate([arr, _np.tile(arr[:1], reps)])
+
+
+def pad_batch(batch, batch_size):
+    """Pad every array in ``batch`` (leading axis) to ``batch_size``.
+
+    Returns ``(padded, mask)`` where ``mask`` is a float32 ``NDArray``
+    of shape ``(batch_size,)`` with 1.0 on original rows and 0.0 on pad
+    rows. Feed the mask as the loss ``sample_weight`` (and divide by
+    ``mask.sum()`` instead of the batch size) and the padded batch
+    produces the same gradients and metrics as discarding the tail —
+    while keeping every step the SAME shape, so nothing retraces.
+
+    ``batch``: an array, or a (possibly nested) list/tuple of arrays
+    (the DataLoader ``[data, label]`` convention). Structure is
+    preserved.
+    """
+    first = batch
+    while isinstance(first, (list, tuple)):
+        first = first[0]
+    n = first.shape[0]
+
+    def walk(obj):
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(walk(o) for o in obj)
+        if obj.shape[0] != n:
+            raise MXNetError(
+                f"pad_batch: leading axes disagree ({obj.shape[0]} vs {n})")
+        return _pad_leaf(obj, batch_size)
+
+    padded = walk(batch)
+    mask = _np.zeros((batch_size,), _np.float32)
+    mask[:n] = 1.0
+    return padded, NDArray(mask)
+
+
+class SequenceBucketer:
+    """Pad variable-length sequences to a fixed set of bucket lengths.
+
+    >>> bucketer = SequenceBucketer([32, 64, 128])
+    >>> x_padded, valid_len = bucketer(x)   # x: (batch, T<=128, ...)
+
+    Every emitted array has one of ``len(buckets)`` shapes, so a
+    hybridized block (or the fused train step) compiles AT MOST
+    ``len(buckets)`` executables — the retrace-count regression test in
+    ``tests/test_fused_step.py`` pins exactly that. Sequences longer
+    than the largest bucket raise (truncation would silently change the
+    math; pick buckets to cover the corpus).
+
+    ``axis``: the sequence axis (default 1, the ``(batch, T)`` layout);
+    ``pad_value``: fill for the padded tail (default 0, the usual
+    ``<pad>`` token id / zero embedding row).
+    """
+
+    def __init__(self, buckets, axis=1, pad_value=0):
+        lens = sorted({int(b) for b in check_shape(buckets)})
+        if not lens or lens[0] <= 0:
+            raise MXNetError(f"invalid bucket lengths {buckets!r}")
+        self.buckets = tuple(lens)
+        self.axis = axis
+        self.pad_value = pad_value
+
+    def bucket_for(self, length: int) -> int:
+        """Smallest bucket >= ``length``."""
+        for b in self.buckets:
+            if length <= b:
+                return b
+        raise MXNetError(
+            f"sequence length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}; add a bucket (truncation is never "
+            "implicit)")
+
+    def __call__(self, arr):
+        """Pad ``arr`` along ``axis`` to its bucket length.
+
+        Returns ``(padded, valid_length)`` — ``valid_length`` is the
+        original length (host int), for masks / ``SequenceMask``.
+        """
+        raw = arr.data if isinstance(arr, NDArray) else arr
+        length = int(raw.shape[self.axis])
+        target = self.bucket_for(length)
+        if target == length:
+            return arr, length
+        pad_width = [(0, 0)] * raw.ndim
+        pad_width[self.axis] = (0, target - length)
+        if isinstance(arr, NDArray):
+            import jax.numpy as jnp
+
+            out = NDArray(jnp.pad(arr.data, pad_width,
+                                  constant_values=self.pad_value),
+                          ctx=arr.ctx)
+        else:
+            out = _np.pad(_np.asarray(raw), pad_width,
+                          constant_values=self.pad_value)
+        return out, length

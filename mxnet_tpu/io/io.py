@@ -243,6 +243,7 @@ class PrefetchingIter(DataIter):
         self.started = True
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
+        self.error = [None] * self.n_iter
 
         def prefetch(i):
             while True:
@@ -253,8 +254,14 @@ class PrefetchingIter(DataIter):
                     self.next_batch[i] = self.iters[i].next()
                 except StopIteration:
                     self.next_batch[i] = None
+                except BaseException as e:  # noqa: BLE001 - must never
+                    # leave the consumer blocked on data_ready forever;
+                    # park the exception for next() to re-raise
+                    self.error[i] = e
+                    self.next_batch[i] = None
                 self.data_taken[i].clear()
                 self.data_ready[i].set()
+            self.data_ready[i].set()  # unblock a consumer racing close()
 
         self.prefetch_threads = [
             threading.Thread(target=prefetch, args=(i,), daemon=True)
@@ -283,14 +290,35 @@ class PrefetchingIter(DataIter):
               for d in i.provide_label]
              for r, i in zip(self.rename_label, self.iters)), [])
 
-    def __del__(self):
+    def close(self):
+        """Idempotent shutdown: signal the prefetch threads and JOIN
+        them (the seed leaked daemon threads that were never joined)."""
+        if not self.started:
+            return
         self.started = False
         for e in self.data_taken:
             e.set()
+        for t in self.prefetch_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def _raise_pending(self):
+        for i, err in enumerate(self.error):
+            if err is not None:
+                self.error[i] = None
+                self.close()
+                raise err
 
     def reset(self):
         for e in self.data_ready:
             e.wait()
+        self._raise_pending()
         for i in self.iters:
             i.reset()
         for e in self.data_ready:
@@ -301,6 +329,7 @@ class PrefetchingIter(DataIter):
     def iter_next(self):
         for e in self.data_ready:
             e.wait()
+        self._raise_pending()
         if self.next_batch[0] is None:
             return False
         self.current_batch = DataBatch(

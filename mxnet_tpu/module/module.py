@@ -106,6 +106,13 @@ class BaseModule:
             validation_metric = eval_metric
         if not isinstance(eval_metric, _metric.EvalMetric):
             eval_metric = _metric.create(eval_metric)
+        # stage batches to the module's device N ahead from a background
+        # thread (MXTPU_DEVICE_PREFETCH, 0 disables); reset()/provide_*
+        # pass through the wrapper, so the epoch loop below is unchanged
+        from ..gluon.data.prefetcher import wrap_for_fit
+
+        train_data = wrap_for_fit(train_data,
+                                  getattr(self, "_context", None))
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()

@@ -165,6 +165,37 @@ PROFILE_COUNTER = _REGISTRY.gauge(
     "mxtpu_profile_counter",
     "user-defined profiler.ProfileCounter values, by counter name")
 
+DATA_PREFETCH_QUEUE_DEPTH = _REGISTRY.gauge(
+    "mxtpu_data_prefetch_queue_depth",
+    "batches currently staged ahead in the DevicePrefetcher queue")
+DATA_PREFETCH_BATCHES = _REGISTRY.counter(
+    "mxtpu_data_prefetch_batches_total",
+    "batches staged to device by the DevicePrefetcher")
+DATA_PREFETCH_WAIT_SECONDS = _REGISTRY.counter(
+    "mxtpu_data_prefetch_wait_seconds_total",
+    "consumer wall time blocked waiting on the prefetch queue (the "
+    "'accelerator idles on the host' signal — near-zero when overlapped)")
+DATA_H2D_BYTES = _REGISTRY.counter(
+    "mxtpu_data_h2d_bytes_total",
+    "host->device batch payload bytes staged by the input pipeline")
+DATA_H2D_SECONDS = _REGISTRY.histogram(
+    "mxtpu_data_h2d_seconds",
+    "host->device staging latency per batch (convert + device_put "
+    "dispatch; async backends may finish the copy later)")
+
+COMPILE_CACHE_HITS = _REGISTRY.counter(
+    "mxtpu_compile_cache_hit_total",
+    "XLA executables served from the persistent compilation cache "
+    "(MXTPU_COMPILE_CACHE)")
+COMPILE_CACHE_MISSES = _REGISTRY.counter(
+    "mxtpu_compile_cache_miss_total",
+    "XLA compiles that missed the persistent compilation cache")
+
+SHAPE_WOBBLE_TOTAL = _REGISTRY.counter(
+    "mxtpu_shape_wobble_total",
+    "CachedGraph shape-signature count exceeded MXTPU_RETRACE_BUDGET, "
+    "by block — pad/bucket the inputs (docs/performance.md)")
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)
@@ -248,6 +279,16 @@ def record_compile(block: str, dt: float, cause=None):
                    args={"cause": cause or "first"})
 
 
+def record_h2d(nbytes: int, dt: float, depth: int):
+    """One prefetched batch staged to device (gluon/data/prefetcher.py)."""
+    DATA_PREFETCH_BATCHES.inc()
+    DATA_H2D_BYTES.inc(nbytes)
+    DATA_H2D_SECONDS.observe(dt)
+    DATA_PREFETCH_QUEUE_DEPTH.set(depth)
+    _TRACER.record("data.h2d", cat="io", ts=_time.perf_counter() - dt,
+                   dur=dt, args={"bytes": nbytes, "queue_depth": depth})
+
+
 # ---------------------------------------------------------------------------
 # exporters / summaries
 # ---------------------------------------------------------------------------
@@ -296,6 +337,16 @@ def summary() -> str:
             f"({int(KV_PULL_BYTES.total())} B), "
             f"{int(KV_PUSHPULL_TOTAL.total())} pushpulls, "
             f"{int(KV_BARRIER_TOTAL.total())} barriers")
+    staged = DATA_PREFETCH_BATCHES.total()
+    if staged:
+        lines.append(
+            f"  input pipeline: {int(staged)} batches staged "
+            f"({int(DATA_H2D_BYTES.total())} B h2d, "
+            f"{DATA_PREFETCH_WAIT_SECONDS.total() * 1e3:.1f} ms "
+            f"consumer wait)")
+    cc_h, cc_m = COMPILE_CACHE_HITS.total(), COMPILE_CACHE_MISSES.total()
+    if cc_h or cc_m:
+        lines.append(f"  compile cache: {int(cc_h)} hits, {int(cc_m)} misses")
     steps = TRAINER_STEP_TOTAL.total()
     if steps:
         mean_ms = TRAINER_STEP_SECONDS.sum() / max(steps, 1) * 1e3

@@ -46,6 +46,14 @@ def shard_batch(arr, mesh, axis_name="dp"):
     the global batch is the concatenation of each rank's owned rows)."""
     raw = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
     sharding = NamedSharding(mesh, P(axis_name, *([None] * (raw.ndim - 1))))
+    if isinstance(raw, jax.Array):
+        try:
+            if raw.sharding.is_equivalent_to(sharding, raw.ndim):
+                # already placed (e.g. staged ahead by DevicePrefetcher):
+                # re-sharding would gather the global batch to host
+                return raw
+        except Exception:
+            pass
     return _put_global(raw, sharding)
 
 
@@ -348,10 +356,22 @@ class SPMDTrainStep:
 
     def __call__(self, x, y, lr=0.01, sync=True):
         if self._state is None:
-            # resolve deferred init with one tiny eager pass
-            xin = x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+            # resolve deferred init with one tiny eager pass. The probe
+            # runs on a HOST copy of one row: the incoming batch may
+            # already be mesh-sharded (DevicePrefetcher stages ahead),
+            # and an eager forward mixing an 8-device input with
+            # single-device params dies in dispatch.
+            import numpy as onp
+
+            raw = x.data if isinstance(x, NDArray) else jnp.asarray(x)
+            if isinstance(raw, jax.Array) and raw.addressable_shards:
+                host = onp.asarray(raw.addressable_shards[0].data)
+            else:
+                host = onp.asarray(raw)
+            xin = NDArray(jnp.asarray(host[0:1] if host.shape[0] > 1
+                                      else host))
             with autograd.predict_mode():
-                self.block(xin[0:1] if xin.shape[0] > 1 else xin)
+                self.block(xin)
             self.init_state()
         raw_x = x.data if isinstance(x, NDArray) else jnp.asarray(x)
         raw_y = y.data if isinstance(y, NDArray) else jnp.asarray(y)

@@ -1,9 +1,66 @@
 """Runtime feature detection (reference: ``python/mxnet/runtime.py`` +
-``src/libinfo.cc``)."""
+``src/libinfo.cc``) and persistent-compilation-cache wiring."""
 
 from __future__ import annotations
 
+import os
+
 import jax
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache (MXTPU_COMPILE_CACHE)
+# ---------------------------------------------------------------------------
+# The reference never recompiled across restarts (kernels were AOT .so
+# code); XLA recompiles every executable per process, which on a pod is
+# minutes of startup per restart. JAX's persistent cache keys compiled
+# executables by (HLO, compile options, backend version) in a shared
+# directory; wiring it behind one env var makes restart N cost tracing
+# only. Hit/miss counts land in the telemetry registry
+# (mxtpu_compile_cache_{hit,miss}_total) via jax.monitoring.
+
+_CACHE_STATE = {"dir": None, "listener": False}
+
+
+def setup_compile_cache(path=None):
+    """Enable JAX's persistent compilation cache at ``path`` (or
+    ``$MXTPU_COMPILE_CACHE``). Idempotent; called automatically the
+    first time a ``Context`` is created. Returns the active cache dir,
+    or None when unconfigured."""
+    from .base import getenv
+
+    path = path or getenv("MXTPU_COMPILE_CACHE")
+    if not path:
+        return _CACHE_STATE["dir"]
+    path = os.path.abspath(os.path.expanduser(str(path)))
+    if _CACHE_STATE["dir"] == path:
+        return path
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache EVERY executable: the defaults skip sub-second compiles,
+    # which is exactly the many-small-executables regime the fused step
+    # produces (and the whole of the CPU test/bench tier)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _CACHE_STATE["dir"] = path
+    if not _CACHE_STATE["listener"]:
+        _CACHE_STATE["listener"] = True
+        import jax.monitoring as _mon
+
+        from . import observability as _obs
+
+        def _on_event(name, **kwargs):
+            if name == "/jax/compilation_cache/cache_hits":
+                _obs.COMPILE_CACHE_HITS.inc()
+            elif name == "/jax/compilation_cache/cache_misses":
+                _obs.COMPILE_CACHE_MISSES.inc()
+
+        _mon.register_event_listener(_on_event)
+    return path
+
+
+def compile_cache_dir():
+    """The active persistent-compile-cache directory (or None)."""
+    return _CACHE_STATE["dir"]
 
 
 class Feature:
@@ -42,6 +99,7 @@ class Features(dict):
             # (reference: MXNET_INT64_TENSOR_SIZE build flag;
             # tests/test_large_tensor.py; docs/design_decisions.md)
             "INT64_TENSOR_SIZE": bool(jax.config.jax_enable_x64),
+            "COMPILE_CACHE": _CACHE_STATE["dir"] is not None,
             "SIGNAL_HANDLER": True,
             "F16C": True,
             "BF16": True,

@@ -26,6 +26,9 @@ def test_bench_emits_driver_contract(tmp_path):
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if "host_platform_device_count" not in f)
+    # keep the smoke from overwriting the repo's committed bench records
+    env["BENCH_PR3_OUT"] = str(tmp_path / "BENCH_pr3.json")
+    env["BENCH_PR4_OUT"] = str(tmp_path / "BENCH_pr4.json")
     res = subprocess.run(
         [sys.executable, "-c", _RUNNER.format(root=ROOT)],
         env=env, capture_output=True, text=True, timeout=600)
@@ -43,3 +46,8 @@ def test_bench_emits_driver_contract(tmp_path):
     assert any("bert" in n for n in names)
     assert any("flash_attention" in n for n in names)
     assert any("allreduce" in n for n in names)
+    assert any(n.startswith("input_pipeline_prefetch") for n in names)
+    # warm persistent-compile-cache start must skip recompilation
+    warm = [r for r in recs
+            if r["metric"].startswith("compile_cache_warm")]
+    assert warm and warm[0]["cache_misses"] == 0, warm

@@ -205,6 +205,43 @@ def test_dispatch_count_constant_in_param_count():
     assert large < 40, large  # 1 fwd + 1 bwd + 1 update + eager loss ops
 
 
+def test_bucketed_variable_length_compiles_bounded_executables():
+    """Shape stabilization (PR4): variable-length batches routed through
+    a SequenceBucketer compile AT MOST len(buckets) train-step variants
+    — the retrace-count extension of the dispatch-count harness. The
+    same lengths unbucketed would compile one executable per length."""
+    from mxnet_tpu.gluon.data import SequenceBucketer
+
+    prev_obs = obs.set_enabled(True)
+    try:
+        mx.random.seed(0)
+        # per-timestep head: handles any sequence length (B, T, 1)
+        net = nn.Dense(4, in_units=1, flatten=False)
+        net.initialize(init=mx.initializer.Xavier())
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=None)
+        buckets = SequenceBucketer([8, 16])
+        obs.reset()
+        lengths = [5, 8, 12, 3, 16, 9, 7]  # 7 lengths -> 2 shapes
+        for t in lengths:
+            x = mx.nd.array(np.random.RandomState(t).rand(4, t, 1)
+                            .astype(np.float32))
+            xb, _valid = buckets(x)
+            with autograd.record():
+                l = (net(xb) ** 2).sum()
+            l.backward()
+            tr.step(4)
+        compiled = obs.CACHEDOP_COMPILE_TOTAL.value(block=net.name)
+        assert compiled <= len(buckets.buckets), \
+            f"{compiled} compiles for {len(buckets.buckets)} buckets"
+        assert tr._fused not in (False, None)
+    finally:
+        obs.set_enabled(prev_obs)
+        obs.reset()
+
+
 def test_grad_norm_gauge_is_lazy_with_fused_step():
     """The fused step folds the grad-norm gauge into the update
     executable: Trainer.step records a device scalar (no sync); the

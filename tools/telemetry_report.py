@@ -19,7 +19,8 @@ import json
 import sys
 
 COLUMNS = (f"{'Name':<40}{'Total Count':>12}{'Time (ms)':>14}"
-           f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}")
+           f"{'Min (ms)':>12}{'Max (ms)':>12}{'Avg (ms)':>12}"
+           f"{'Bytes':>14}")
 
 _SORTS = {
     "total": lambda kv: kv[1][1],
@@ -27,8 +28,19 @@ _SORTS = {
     "min": lambda kv: kv[1][2],
     "max": lambda kv: kv[1][3],
     "avg": lambda kv: kv[1][1] / kv[1][0] if kv[1][0] else 0.0,
+    "bytes": lambda kv: kv[1][4],
     "name": lambda kv: kv[0],
 }
+
+
+def _fmt_bytes(n):
+    if not n:
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"  # pragma: no cover - loop always returns
 
 
 def load_events(source):
@@ -54,20 +66,30 @@ def load_events(source):
 
 
 def aggregate(events, cat=None):
-    """name -> [count, total_ms, min_ms, max_ms] over duration events."""
+    """name -> [count, total_ms, min_ms, max_ms, bytes] over duration
+    events. ``bytes`` sums the ``args.bytes`` payload some series carry
+    (kvstore.allreduce, data.h2d); unknown series and non-dict args
+    aggregate fine with 0 — the report never crashes on a new series."""
     agg = {}
     for ev in events:
         if cat and ev.get("cat") != cat:
             continue
         ms = float(ev.get("dur", 0.0)) / 1e3  # trace dur is microseconds
+        args = ev.get("args")
+        try:
+            nbytes = float(args.get("bytes", 0)) if isinstance(args, dict) \
+                else 0.0
+        except (TypeError, ValueError):
+            nbytes = 0.0
         rec = agg.get(ev["name"])
         if rec is None:
-            agg[ev["name"]] = [1, ms, ms, ms]
+            agg[ev["name"]] = [1, ms, ms, ms, nbytes]
         else:
             rec[0] += 1
             rec[1] += ms
             rec[2] = min(rec[2], ms)
             rec[3] = max(rec[3], ms)
+            rec[4] += nbytes
     return agg
 
 
@@ -76,10 +98,11 @@ def render_table(events, cat=None, sort_by="total", ascending=False):
     agg = aggregate(events, cat=cat)
     lines = ["Telemetry Trace Statistics:", COLUMNS]
     key = _SORTS.get(sort_by, _SORTS["total"])
-    for name, (cnt, tot, mn, mx) in sorted(agg.items(), key=key,
-                                           reverse=not ascending):
+    for name, (cnt, tot, mn, mx, nbytes) in sorted(agg.items(), key=key,
+                                                   reverse=not ascending):
         lines.append(f"{name:<40}{cnt:>12}{tot:>14.4f}"
-                     f"{mn:>12.4f}{mx:>12.4f}{tot / cnt:>12.4f}")
+                     f"{mn:>12.4f}{mx:>12.4f}{tot / cnt:>12.4f}"
+                     f"{_fmt_bytes(nbytes):>14}")
     if not agg:
         lines.append("(no events)")
     return "\n".join(lines)
