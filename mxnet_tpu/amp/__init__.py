@@ -1,34 +1,61 @@
 """Automatic Mixed Precision (reference: ``python/mxnet/contrib/amp/``).
 
-TPU-native: bf16 is the native mixed-precision dtype — no loss scaling is
-required (bf16 has fp32's exponent range), so the reference's dynamic
-loss-scaler machinery collapses to a near-no-op policy (SURVEY.md §7 S5:
-"amp.init() becomes near-no-op policy setting"). The fp16 path keeps a
-dynamic scaler for parity.
+TPU-native: bf16 is the native mixed-precision dtype — no loss scaling
+is required (bf16 has fp32's exponent range), so ``amp.init("bfloat16")``
+is a *policy switch*: low-precision math everywhere except the FP32 op
+list (``policy.FP32_OPS``), which is enforced inside each op's compiled
+executable at dispatch / CachedGraph-trace time. The fp16 path keeps a
+dynamic loss scaler for parity with the reference — and since PR 5 the
+scaler runs IN-GRAPH when the fused train step is active: scale/unscale,
+the all-finite overflow check, skip-update and the dynamic scale
+adjustment all live inside the one-dispatch update executable
+(``gluon/trainer.py``), with the scale and overflow counters surfaced
+lazily through telemetry (``mxtpu_amp_loss_scale`` /
+``mxtpu_amp_overflow_total``). No per-step host sync anywhere.
+
+Master weights: pass ``multi_precision=True`` to the optimizer/Trainer —
+bf16/fp16 params then keep fp32 master copies (in the fused update's
+donated pytree, or per-param on the eager path; both migrate when the
+paths switch). ``Optimizer.create_state_multi_precision`` covers
+``bfloat16`` as well as ``float16``.
+
+Reduced-precision gradient allreduce: ``MXTPU_AMP_ALLREDUCE_DTYPE=bfloat16``
+ships fp32 gradient buckets over the wire in bf16 (fp32 accumulation) —
+see ``kvstore/local.py`` and ``docs/performance.md``.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from . import policy
+from .policy import FP32_OPS  # noqa: F401  (documented policy surface)
 
-_STATE = {"target_dtype": None}
-
-# op families the reference forces to fp32 (lists/symbol_fp16.py):
-# reductions, softmax/norm/exp-type ops stay fp32 — XLA handles this per-op
-# via dtype promotion; the cast policy below applies at block boundaries.
-FP32_OPS = ("softmax", "log_softmax", "norm", "mean", "sum", "BatchNorm",
-            "LayerNorm")
+# the SAME dict policy.py owns — legacy callers/tests mutate
+# ``amp._STATE["target_dtype"]`` directly and every check reads it
+_STATE = policy._STATE
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable AMP. On TPU prefer bfloat16 (default)."""
+    """Enable AMP. On TPU prefer bfloat16 (default).
+
+    ``fp32_ops`` extends the default FP32 cast list (op names);
+    ``target_precision_ops``/``conditional_fp32_ops`` are accepted for
+    reference API parity (XLA's dtype propagation already runs eligible
+    ops in the target dtype, so there is no separate low-precision
+    force-list to enforce)."""
     if target_dtype not in ("bfloat16", "float16"):
         raise MXNetError("target_dtype must be bfloat16 or float16")
-    _STATE["target_dtype"] = target_dtype
+    policy.set_policy(target_dtype, fp32_ops=fp32_ops)
+
+
+def disable():
+    """Turn the AMP cast policy off (tests / notebooks)."""
+    policy.clear_policy()
 
 
 def is_enabled():
@@ -46,61 +73,150 @@ def init_trainer(trainer):
     return trainer
 
 
+def _norm_block_types():
+    from ..gluon.nn.basic_layers import (BatchNorm, GroupNorm, InstanceNorm,
+                                         LayerNorm)
+
+    return (BatchNorm, LayerNorm, InstanceNorm, GroupNorm)
+
+
 def convert_model(net, target_dtype=None):
-    """Cast a Gluon block to the AMP dtype, keeping norm-layer statistics
-    in fp32 (``BatchNorm.cast`` pins them)."""
+    """Cast a Gluon block to the AMP dtype, keeping norm layers
+    (BatchNorm/LayerNorm/InstanceNorm/GroupNorm — parameters AND moving
+    statistics) in fp32: their per-channel scale/shift and running stats
+    are tiny, precision-critical, and free to keep wide (the ops cast
+    them to the activation dtype at the use site, so activations stay
+    low-precision end to end)."""
     dtype = target_dtype or _STATE["target_dtype"] or "bfloat16"
     net.cast(dtype)
+    norm_types = _norm_block_types()
+
+    def repin(block):
+        if isinstance(block, norm_types):
+            block.cast("float32")
+
+    net.apply(repin)
     return net
 
 
 convert_hybrid_block = convert_model
 
 
+def _collect_grad_raws(params):
+    """Raw grad arrays from a mixed list of Parameters / NDArrays /
+    arrays (the reference accepted all three)."""
+    raws = []
+    for p in params:
+        grad_attr = getattr(p, "grad", None)
+        if callable(grad_attr):
+            g = grad_attr()          # Parameter.grad() method
+        elif grad_attr is not None:
+            g = grad_attr            # raw array with an attached grad
+        else:
+            g = p                    # plain array: inspect its values
+        if g is None:
+            continue
+        raws.append(g.data if isinstance(g, NDArray) else jnp.asarray(g))
+    return raws
+
+
+@jax.jit
+def _any_nonfinite(raws):
+    """ONE fused reduction over the whole gradient set (replaces the
+    per-param host-side numpy scan)."""
+    bad = jnp.bool_(False)
+    for g in raws:
+        bad = jnp.logical_or(bad, jnp.logical_not(jnp.all(jnp.isfinite(g))))
+    return bad
+
+
 class LossScaler:
     """Dynamic loss scaling (reference: ``loss_scaler.py``). Needed only
-    for fp16; bf16 trains unscaled."""
+    for fp16; bf16 trains unscaled.
+
+    The scale, the stable-step counter and the overflow total live as
+    DEVICE scalars so the fused train step can read and update them
+    in-graph with zero host syncs; the ``loss_scale`` property
+    materializes a float on read (host introspection only)."""
 
     def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
                  scale_window=2000):
-        self.loss_scale = init_scale
-        self._factor = scale_factor
-        self._window = scale_window
-        self._unskipped = 0
+        self._factor = float(scale_factor)
+        self._window = int(scale_window)
+        self._scale_arr = jnp.asarray(float(init_scale), jnp.float32)
+        self._unskipped_arr = jnp.asarray(0, jnp.int32)
+        self._overflow_total_arr = jnp.asarray(0, jnp.int32)
+
+    @property
+    def loss_scale(self):
+        return float(self._scale_arr)  # syncs — host introspection only
+
+    @loss_scale.setter
+    def loss_scale(self, value):
+        self._scale_arr = jnp.asarray(float(value), jnp.float32)
+
+    @property
+    def _unskipped(self):
+        return int(self._unskipped_arr)
+
+    @property
+    def overflow_total(self):
+        return int(self._overflow_total_arr)
 
     def has_overflow(self, params):
-        import numpy as np
-
-        for p in params:
-            # accepts Parameters (grad() method) and raw arrays (whose
-            # .grad ATTRIBUTE is None unless autograd attached one)
-            grad_attr = getattr(p, "grad", None)
-            if callable(grad_attr):
-                g = grad_attr()          # Parameter.grad() method
-            elif grad_attr is not None:
-                g = grad_attr            # raw array with an attached grad
-            else:
-                g = p                    # plain array: inspect its values
-            if g is None:
-                continue
-            a = g.asnumpy()
-            if not np.isfinite(a).all():
-                return True
-        return False
+        """True if any gradient holds a non-finite value. One fused
+        ``isfinite`` reduction + one scalar sync, regardless of the
+        number of parameters."""
+        raws = _collect_grad_raws(params)
+        if not raws:
+            return False
+        return bool(_any_nonfinite(raws))
 
     def update_scale(self, overflow):
+        """Host-side scale adjustment (the eager fallback path; the
+        fused step performs the same arithmetic in-graph)."""
+        scale = float(self._scale_arr)
+        unskipped = int(self._unskipped_arr)
         if overflow:
-            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
-            self._unskipped = 0
+            scale = max(scale / self._factor, 1.0)
+            unskipped = 0
+            self._overflow_total_arr = jnp.asarray(
+                int(self._overflow_total_arr) + 1, jnp.int32)
         else:
-            self._unskipped += 1
-            if self._unskipped >= self._window:
-                self.loss_scale *= self._factor
-                self._unskipped = 0
+            unskipped += 1
+            if unskipped >= self._window:
+                scale *= self._factor
+                unskipped = 0
+        self._scale_arr = jnp.asarray(scale, jnp.float32)
+        self._unskipped_arr = jnp.asarray(unskipped, jnp.int32)
+        from .. import observability as _obs
+
+        if _obs.ENABLED:
+            _obs.record_amp_scale(scale, int(self._overflow_total_arr),
+                                  bool(overflow))
 
 
 class scale_loss:
-    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``"""
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``
+
+    The loss is multiplied by the current scale as a LAZY device scalar
+    (no sync); unscaling, the overflow check, the skip decision and the
+    scale update are all deferred to ``trainer.step`` — in-graph when
+    the fused update runs, one fused ``isfinite`` reduction on the
+    per-param fallback. Contract for the in-between window:
+
+    - the gradient buffers hold SCALED values between ``backward()``
+      and ``step()`` — and, on the fused path, after ``step()`` too
+      (the unscale happens inside the update executable, never as an
+      extra buffer rewrite; the per-param fallback does rewrite them).
+      Call ``amp.unscale(trainer)`` whenever you need TRUE gradients —
+      e.g. for manual clipping — regardless of path; the overflow
+      check + skip + scale backoff stay armed afterwards.
+    - if you DISCARD a scaled backward without calling ``step()``
+      (bad-batch guard), call ``amp.unscale(trainer)`` or
+      ``trainer.step`` before the next unscaled backward — the
+      deferred flag would otherwise divide that later backward's true
+      gradients by the loss scale."""
 
     def __init__(self, loss, trainer):
         self._loss = loss
@@ -110,28 +226,56 @@ class scale_loss:
     def __enter__(self):
         if self._scaler is None:
             return self._loss
-        scale = self._scaler.loss_scale
+        scale = NDArray(self._scaler._scale_arr)
         if isinstance(self._loss, (list, tuple)):
             return [l * scale for l in self._loss]
         return self._loss * scale
 
-    def __exit__(self, *exc):
-        if self._scaler is not None:
-            params = [p for p in self._trainer._params if p.grad_req != "null"]
-            overflow = self._scaler.has_overflow(params)
-            if not overflow:
-                # unscale with the SAME factor the loss was multiplied by,
-                # before the scaler adjusts it for the next step
-                inv = 1.0 / self._scaler.loss_scale
-                for p in params:
-                    for g in p.list_grad():
-                        g._set_data(g.data * inv)
-            else:  # skip step by zeroing grads
-                for p in params:
-                    p.zero_grad()
-            self._scaler.update_scale(overflow)
+    def __exit__(self, exc_type, *exc):
+        if self._scaler is not None and exc_type is None:
+            self._trainer._amp_pending = "scaled"
         return False
 
 
 def unscale(trainer):
-    pass
+    """Divide the attached gradients by the pending loss scale NOW (one
+    fused executable over the grad list) — for users who inspect or
+    clip gradients between ``backward()`` and ``step()``. No-op unless
+    a ``scale_loss`` block just ran. The pending state moves to
+    ``"unscaled"``, NOT off: the following ``trainer.step`` still runs
+    the overflow check, the skip decision and the scale update (an inf
+    stays inf through the division) — it just must not divide again."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or getattr(trainer, "_amp_pending", False) != "scaled":
+        return
+    trainer._amp_pending = "unscaled"
+    raws, outs = [], []
+    for p in trainer._params:
+        if p.grad_req == "null" or p._data is None:
+            continue
+        try:
+            gs = p.list_grad()
+        except Exception:
+            continue
+        for g in gs:
+            if g is not None:
+                raws.append(g.data)
+                outs.append(g)
+    if not raws:
+        return
+    scaled = _unscale_all(raws, scaler._scale_arr)
+    for g, r in zip(outs, scaled):
+        g._set_data(r)
+
+
+@jax.jit
+def _unscale_all(raws, scale):
+    inv = 1.0 / scale
+    return [g * inv.astype(g.dtype) for g in raws]
+
+
+# bind the cast policy into the op registry (lazy hot-path check there
+# reads the shared _STATE dict; see ops/registry.jitted)
+from ..ops import registry as _registry  # noqa: E402
+
+_registry._AMP_STATE = _STATE

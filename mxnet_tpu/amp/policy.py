@@ -1,0 +1,122 @@
+"""AMP cast-policy state + the op-dispatch cast wrapper.
+
+Deliberately dependency-light: this module is consulted from the op
+registry's hot path (``ops/registry.jitted``) and from the CachedGraph
+signature key (``gluon/block.py``), both of which sit below the rest of
+the ``amp`` package in the import graph. It imports only jax.numpy.
+
+The policy is the TPU-native form of the reference's
+``contrib/amp/lists/symbol_fp16.py``: ops whose accumulation blows up in
+half precision (reductions, softmax-family, norm layers) run in fp32
+even when the surrounding network computes in bf16/fp16. Enforcement
+happens INSIDE the op's compiled executable — inputs are upcast and the
+result downcast as part of the same XLA program, so the policy adds
+zero dispatches and composes with the fused train step and with
+``_CachedGraph`` tracing (the casts land in the traced jaxpr).
+
+``BatchNorm`` is on the documented FP32 list but is enforced
+structurally, not by the dispatch wrapper: its statistics already
+accumulate in fp32 inside the op (``_f32_moments``) and its
+moving-stat outputs must keep their STORAGE dtype (an output downcast
+here would silently flip the fp32-pinned aux params to bf16 through the
+CachedGraph mutation writeback).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: op families the reference forces to fp32 (lists/symbol_fp16.py):
+#: reductions, softmax/norm/exp-type ops. This is the DOCUMENTED policy
+#: surface; ``amp.init(fp32_ops=...)`` extends it.
+FP32_OPS = (
+    "softmax", "log_softmax", "softmin", "SoftmaxOutput",
+    "softmax_cross_entropy", "norm", "mean", "sum", "nansum",
+    "logsumexp", "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+    "L2Normalization", "exp", "log", "smooth_l1",
+)
+
+#: FP32_OPS members enforced inside their op implementation rather than
+#: by the dispatch wrapper (see module docstring).
+_STRUCTURAL = frozenset({"BatchNorm"})
+
+#: THE shared state. ``target_dtype`` None means AMP is off (legacy
+#: tests flip this key directly, so every check reads the dict).
+_STATE = {
+    "target_dtype": None,
+    # op names the dispatch wrapper upcasts; pre-seeded so flipping
+    # target_dtype directly (without init()) still gets the default set
+    "cast_ops": frozenset(FP32_OPS) - _STRUCTURAL,
+}
+
+_LOW = ("bfloat16", "float16")
+
+
+def is_low_precision_dtype(dtype) -> bool:
+    """THE {float16, bfloat16} predicate for master-weight and cast
+    decisions — single-sourced here (the dependency-light bottom of the
+    import graph) so the fused update, the eager optimizer, and the
+    cast policy can never disagree about what counts as low precision."""
+    return str(dtype) in _LOW
+
+
+def target_dtype():
+    return _STATE["target_dtype"]
+
+
+def is_enabled() -> bool:
+    return _STATE["target_dtype"] is not None
+
+
+def cast_active() -> bool:
+    return _STATE["target_dtype"] is not None
+
+
+def set_policy(target_dtype, fp32_ops=None):
+    """Activate AMP with the default FP32 set plus ``fp32_ops`` extras."""
+    ops = frozenset(FP32_OPS) | frozenset(fp32_ops or ())
+    _STATE["cast_ops"] = ops - _STRUCTURAL
+    _STATE["target_dtype"] = target_dtype
+
+
+def clear_policy():
+    _STATE["target_dtype"] = None
+
+
+def _is_low(x) -> bool:
+    dt = getattr(x, "dtype", None)
+    return dt is not None and is_low_precision_dtype(dt)
+
+
+def wrap_fp32(fn):
+    """Wrap an op implementation with the fp32 cast policy: low-precision
+    float inputs are upcast to fp32, the op runs, and fp32 outputs are
+    cast back to the (widest) low input dtype. Runs under jit — the
+    casts are part of the op's own executable and of any enclosing
+    CachedGraph trace, never extra dispatches. Gradients flow through
+    the casts (astype's vjp casts the cotangent back)."""
+
+    def wrapped(*xs):
+        low = None
+        for x in xs:
+            if _is_low(x):
+                low = jnp.promote_types(low, x.dtype) if low is not None \
+                    else jnp.dtype(x.dtype)
+        if low is None or str(low) not in _LOW:
+            # nothing to protect (or mixed bf16+fp16 already promotes to
+            # fp32 on its own): run the op untouched
+            return fn(*xs)
+        cast_in = [x.astype(jnp.float32) if _is_low(x) else x for x in xs]
+        out = fn(*cast_in)
+
+        def back(o):
+            dt = getattr(o, "dtype", None)
+            if dt is not None and str(dt) == "float32":
+                return o.astype(low)
+            return o
+
+        if isinstance(out, (tuple, list)):
+            return type(out)(back(o) for o in out)
+        return back(out)
+
+    return wrapped

@@ -67,6 +67,29 @@ def bucket_bytes() -> int:
                       dtype=int))
 
 
+_AMP_AR_DTYPES = ("bfloat16", "float16")
+_AMP_AR_WARNED = [False]
+
+
+def amp_allreduce_dtype() -> str:
+    """Reduced-precision gradient allreduce dtype from
+    ``MXTPU_AMP_ALLREDUCE_DTYPE`` ("" = off, the default). When set to
+    ``bfloat16``/``float16``, fp32 gradient buckets are cast down
+    before crossing the wire (halving ICI/DCN bytes) and summed with
+    fp32 accumulation on the other side — see docs/performance.md
+    "mixed precision". Unknown values are ignored with one loud
+    warning (a typo must not silently change training numerics)."""
+    v = getenv("MXTPU_AMP_ALLREDUCE_DTYPE", "", dtype=str) or ""
+    if v and v not in _AMP_AR_DTYPES:
+        if not _AMP_AR_WARNED[0]:
+            _AMP_AR_WARNED[0] = True
+            _logger.warning(
+                "MXTPU_AMP_ALLREDUCE_DTYPE=%r is not one of %s; "
+                "gradient allreduce stays full precision", v, _AMP_AR_DTYPES)
+        return ""
+    return v
+
+
 _RETRACE_BUDGET_DEFAULT = 8
 
 

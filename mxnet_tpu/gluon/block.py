@@ -31,6 +31,7 @@ from .. import autograd
 from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from .. import random as _random
+from ..amp import policy as _amp_policy
 from ..base import MXNetError
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
@@ -548,6 +549,13 @@ class _CachedGraph:
             # only recording entries differ under the fused step, so the
             # flag keys them alone (flipping it never retraces inference)
             recording and _fusedstep.ENABLED,
+            # the AMP cast policy rewrites FP32-list ops inside the
+            # trace: toggling amp.init() — or re-initializing with a
+            # different fp32_ops extension — must not replay a
+            # pre-policy executable
+            None if _amp_policy._STATE["target_dtype"] is None else
+            (_amp_policy._STATE["target_dtype"],
+             _amp_policy._STATE["cast_ops"]),
         )
         entry = self._cache.get(key)
         if entry is not None:
@@ -607,8 +615,8 @@ class _CachedGraph:
         trap; SURVEY.md flags shape churn as the #1 TPU perf pathology)."""
         if self._last_key is None:
             return None
-        o_sig, o_train, o_rec, o_tracked, o_fused = self._last_key
-        n_sig, n_train, n_rec, n_tracked, n_fused = new_key
+        o_sig, o_train, o_rec, o_tracked, o_fused, o_amp = self._last_key
+        n_sig, n_train, n_rec, n_tracked, n_fused, n_amp = new_key
         causes = []
         if o_sig != n_sig:
             if len(o_sig) != len(n_sig):
@@ -626,6 +634,8 @@ class _CachedGraph:
             causes.append("inputs_tracked")
         if o_fused != n_fused:
             causes.append("fused_step")
+        if o_amp != n_amp:
+            causes.append("amp")
         return "+".join(causes) or "unknown"
 
     def _build(self, args, arrays, handles, diff_mask, ctx, training, recording,

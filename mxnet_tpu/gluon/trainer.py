@@ -253,8 +253,6 @@ class Trainer:
         # _maybe_fused_update — a disabled flag never reaches here)
         if name not in self._FUSABLE:
             return no(f"optimizer '{name}' has no fused pytree rule")
-        if o.multi_precision:
-            return no("multi_precision uses the per-param master-weight path")
         if name == "lamb" and (
                 getattr(o, "lower_bound", None) is not None
                 or getattr(o, "upper_bound", None) is not None
@@ -272,12 +270,17 @@ class Trainer:
         if any(g is None for g in grads):
             return no("gradient buffers not attached")
 
-        from ..parallel.spmd import _RULES
+        from ..parallel.spmd import _RULES, mp_rule
 
         hyper = {k: getattr(o, k) for k in self._FUSABLE[name]
                  if hasattr(o, k)}
         hyper["wd"] = o.wd
         rule_init, rule_update = _RULES[name](hyper)
+        if o.multi_precision:
+            # fp32 master weights for bf16/fp16 params live as state
+            # leaf 0 in the donated pytree (the multi-tensor analog of
+            # the reference's mp_sgd/mp_adam kernels)
+            rule_init, rule_update = mp_rule(rule_init, rule_update)
         idx = [self._param2idx[p.name] for p in active]
         states = [self._restore_fused_state(name, p, i, h.data, rule_init)
                   for p, i, h in zip(active, idx, handles)]
@@ -286,23 +289,72 @@ class Trainer:
         # more — only pay that when telemetry is on (toggling telemetry
         # rebuilds the plan via the staleness guard)
         with_gnorm = _obs.ENABLED
+        # fp16 AMP: loss scaling runs INSIDE this executable — unscale
+        # (folded into rescale), the all-finite check, skip-update via
+        # where, and the dynamic scale adjustment; factor/window are
+        # trace constants, the scale/counters ride as device operands
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        has_amp = scaler is not None
+        amp_factor = scaler._factor if has_amp else 2.0
+        amp_window = scaler._window if has_amp else 0
 
-        def fused(ws, gs, sts, lr, wd, rescale, clip, lr_mults, wd_mults):
+        # ``unscale_div`` is the factor still LEFT to divide out of the
+        # grad buffers (the live scale normally; 1.0 after the user
+        # already called amp.unscale, or with no scale_loss pending);
+        # ``scale`` always carries the real scale for the backoff/growth
+        # arithmetic — the two diverge exactly when amp.unscale ran
+        def fused(ws, gs, sts, lr, wd, rescale, clip, lr_mults, wd_mults,
+                  scale, unscale_div, unskipped, ovf_total):
+            if has_amp:
+                finite = jnp.bool_(True)
+                for g in gs:
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(g)))
+                rescale = rescale / unscale_div  # unscale rides the rescale
             new_ws, new_sts, sq = [], [], []
             for i, (w, g, s) in enumerate(zip(ws, gs, sts)):
                 if with_gnorm:
                     g32 = g.astype(jnp.float32)
                     sq.append(jnp.vdot(g32, g32))  # pre-rescale: parity
+                if has_amp:
+                    # upcast BEFORE the combined (1/batch)/loss_scale
+                    # factor touches the grad: at batch 512 x scale 2^16
+                    # that factor is 3e-8, below fp16's 6e-8 subnormal
+                    # floor — applied in g.dtype it rounds to literal 0
+                    # and every update silently vanishes
+                    g = g.astype(jnp.float32)
                 g = g * rescale.astype(g.dtype)    # with _grad_norm
                 if has_clip:
                     c = clip.astype(g.dtype)
                     g = jnp.clip(g, -c, c)
                 w2, s2 = rule_update(w, g, s, lr * lr_mults[i],
                                      wd=wd * wd_mults[i])
+                if has_amp:
+                    # skip-update: a non-finite gradient set leaves the
+                    # weights AND the whole state pytree untouched — no
+                    # NaN can reach the (master) weights
+                    w2 = jnp.where(finite, w2, w)
+                    s2 = tuple(jnp.where(finite, a, b)
+                               for a, b in zip(s2, s))
                 new_ws.append(w2)
                 new_sts.append(s2)
             gnorm = jnp.sqrt(sum(sq)) if sq else jnp.float32(0.0)
-            return new_ws, new_sts, gnorm
+            if has_amp:
+                # the buffers hold SCALED grads under deferred
+                # scale_loss; report the TRUE norm (old scale_loss
+                # unscaled the buffers before any norm read)
+                gnorm = gnorm / unscale_div
+            if has_amp:
+                ovf = jnp.logical_not(finite)
+                unsk1 = unskipped + 1
+                grow = unsk1 >= amp_window
+                scale = jnp.where(
+                    ovf, jnp.maximum(scale / amp_factor, 1.0),
+                    jnp.where(grow, scale * amp_factor, scale))
+                unskipped = jnp.where(jnp.logical_or(ovf, grow),
+                                      jnp.zeros_like(unskipped), unsk1)
+                ovf_total = ovf_total + ovf.astype(ovf_total.dtype)
+            return new_ws, new_sts, gnorm, scale, unskipped, ovf_total
 
         fused_jit = jax.jit(
             fused,
@@ -317,6 +369,13 @@ class Trainer:
                 "req_sig": tuple(p.grad_req for p in self._params),
                 "multi_precision": o.multi_precision,
                 "with_gnorm": with_gnorm,
+                "amp": has_amp, "amp_hyper": (amp_factor, amp_window),
+                # scaler-shaped neutral operands for the non-amp (and
+                # not-pending) case, built ONCE (a fresh jnp scalar per
+                # step would be an extra device_put dispatch)
+                "amp_neutral": (jnp.asarray(1.0, jnp.float32),
+                                jnp.asarray(0, jnp.int32),
+                                jnp.asarray(0, jnp.int32)),
                 # trace CONSTANTS (momentum/betas/epsilon — wd is an
                 # operand): the per-step staleness guard compares these
                 # so direct attribute mutation rebuilds instead of
@@ -324,12 +383,20 @@ class Trainer:
                 "static_hyper": {k: v for k, v in hyper.items()
                                  if k != "wd"}}
 
+    @staticmethod
+    def _mp_low(raw) -> bool:
+        from ..amp.policy import is_low_precision_dtype
+
+        return is_low_precision_dtype(raw.dtype)
+
     def _restore_fused_state(self, name, p, idx, raw, rule_init):
         """Optimizer state for one param: prefer the state a previous
         fused plan left in ``_fused_states``; else migrate a per-param
         eager state (``param._opt_state``); else a fresh init — so
         flipping between paths or rebuilding the plan never resets
-        momentum."""
+        momentum. Under ``multi_precision`` the low-precision params'
+        pytrees carry the fp32 master as leaf 0 (see ``spmd.mp_rule``)
+        and migration preserves it in both directions."""
         expected = rule_init(raw)
         cached = self._fused_states.get(p.name)
         if cached is not None and len(cached) == len(expected) and all(
@@ -338,34 +405,57 @@ class Trainer:
             return cached
         st = getattr(p, "_opt_state", None)
         o = self._optimizer
+        mp = o.multi_precision and self._mp_low(raw)
         if st is not None:
             # COPIES: the fused executable donates its state buffers, and
             # aliasing the eager NDArray state would kill it. Ownership
             # TRANSFERS to the fused path (the eager copy is deleted) so
             # a later flip back never resurrects a stale state.
             t = o._index_update_count.get(idx, o.begin_num_update)
+            prefix = ()
+            inner_expected = expected
+            inner_st = st
+            ok = True
+            if mp:
+                # eager mp state: (fp32 master NDArray, inner state)
+                if isinstance(st, tuple) and len(st) == 2 and \
+                        getattr(st[0], "shape", None) == expected[0].shape:
+                    prefix = (jnp.copy(st[0].data)
+                              .astype(expected[0].dtype),)
+                    inner_expected = expected[1:]
+                    inner_st = st[1]
+                else:
+                    ok = False
             migrated = None
-            if name in ("sgd", "nag") and len(expected) == 1 \
-                    and getattr(st, "shape", None) == expected[0].shape:
-                migrated = (jnp.copy(st.data).astype(expected[0].dtype),)
-            elif name in ("adam", "lamb") and isinstance(st, tuple) \
-                    and len(st) == 2:
-                m, v = st
-                if getattr(m, "shape", None) == expected[0].shape:
-                    migrated = (jnp.copy(m.data).astype(expected[0].dtype),
-                                jnp.copy(v.data).astype(expected[1].dtype),
-                                jnp.asarray(t, jnp.int32))
+            if ok:
+                if name in ("sgd", "nag") and len(inner_expected) == 0 \
+                        and inner_st is None:
+                    migrated = prefix  # momentum=0: master only
+                elif name in ("sgd", "nag") and len(inner_expected) == 1 \
+                        and getattr(inner_st, "shape", None) \
+                        == inner_expected[0].shape:
+                    migrated = prefix + (jnp.copy(inner_st.data)
+                                         .astype(inner_expected[0].dtype),)
+                elif name in ("adam", "lamb") \
+                        and isinstance(inner_st, tuple) \
+                        and len(inner_st) == 2:
+                    m, v = inner_st
+                    if getattr(m, "shape", None) == inner_expected[0].shape:
+                        migrated = prefix + (
+                            jnp.copy(m.data).astype(inner_expected[0].dtype),
+                            jnp.copy(v.data).astype(inner_expected[1].dtype),
+                            jnp.asarray(t, jnp.int32))
             if migrated is not None:
                 del p._opt_state
                 return migrated
-        if name in ("adam", "lamb") and len(expected) == 3:
+        if name in ("adam", "lamb") and len(expected) >= 3:
             # fresh state: the bias-correction step count continues from
             # the optimizer's counts (begin_num_update / prior eager
             # steps), matching the eager path's t=_index_update_count
+            # (the t leaf is LAST; with a master prefix it sits at 3)
             t0 = o._index_update_count.get(idx, o.begin_num_update)
             if t0:
-                expected = (expected[0], expected[1],
-                            jnp.asarray(t0, jnp.int32))
+                expected = expected[:-1] + (jnp.asarray(t0, jnp.int32),)
         return expected
 
     def _migrate_fused_to_eager(self, param, idx, weight):
@@ -373,7 +463,9 @@ class Trainer:
         from the fused one (flag flipped, model turned ineligible), its
         optimizer state seeds from the fused pytree state so momentum is
         never silently reset. Ownership transfers (the fused copy is
-        dropped)."""
+        dropped). ``multi_precision`` states rebuild the eager
+        ``(fp32 master NDArray, inner)`` pair from the pytree's master
+        leaf."""
         from ..ndarray.ndarray import NDArray
 
         st = self._fused_states.pop(param.name, None)
@@ -381,6 +473,25 @@ class Trainer:
             return None
         o = self._optimizer
         name = type(o).__name__.lower()
+        mp = o.multi_precision and self._mp_low(weight.data)
+        if mp:
+            if not st:
+                return None
+            master = NDArray(jnp.copy(st[0]), ctx=weight.ctx)  # stays f32
+            inner = tuple(st[1:])
+            mk32 = lambda raw: NDArray(jnp.copy(raw), ctx=weight.ctx)  # noqa: E731
+            if name in ("sgd", "nag"):
+                if len(inner) == 0:
+                    return (master, None)
+                if len(inner) == 1:
+                    return (master, mk32(inner[0]))
+            if name in ("adam", "lamb") and len(inner) == 3:
+                m, v, t = inner
+                o._index_update_count[idx] = max(
+                    o._index_update_count.get(idx, o.begin_num_update),
+                    int(t))
+                return (master, (mk32(m), mk32(v)))
+            return None
         wdt = weight.data.dtype
         mk = lambda raw: NDArray(jnp.copy(raw).astype(wdt),  # noqa: E731
                                  ctx=weight.ctx)
@@ -402,12 +513,16 @@ class Trainer:
         if not plan:
             return None
         o = self._optimizer
+        scaler = getattr(self, "_amp_loss_scaler", None)
         # staleness guards (pure Python, no device work): hyperparameter
         # shape changes or re-initialized params rebuild the plan
         if ((o.clip_gradient is not None) != plan["has_clip"]
                 or type(o).__name__.lower() != plan["name"]
                 or _obs.ENABLED != plan["with_gnorm"]
                 or o.multi_precision != plan["multi_precision"]
+                or (scaler is not None) != plan["amp"]
+                or (scaler is not None
+                    and (scaler._factor, scaler._window) != plan["amp_hyper"])
                 or tuple(p.grad_req for p in self._params) != plan["req_sig"]
                 or any(getattr(o, k, None) != v
                        for k, v in plan["static_hyper"].items())
@@ -433,11 +548,31 @@ class Trainer:
         rescale = jnp.asarray(o.rescale_grad, jnp.float32)
         clip = jnp.asarray(o.clip_gradient if plan["has_clip"] else 0.0,
                            jnp.float32)
+        # fp16 AMP operands: a pending scale_loss block hands its scale
+        # in as a device scalar; without one the neutral constants ride
+        # along (the executable still skip-protects against non-finite
+        # grads, it just leaves the scaler untouched). A pending of
+        # "unscaled" (amp.unscale already divided the buffers) keeps
+        # the overflow check + scale update armed but must not divide
+        # again — unscale_div rides as its own operand.
+        pending = plan["amp"] and getattr(self, "_amp_pending", False)
+        if pending:
+            self._amp_pending = False
+            scale_in = scaler._scale_arr
+            unsk_in = scaler._unskipped_arr
+            div_in = scaler._scale_arr if pending == "scaled" \
+                else plan["amp_neutral"][0]
+        else:
+            scale_in, unsk_in, _ = plan["amp_neutral"]
+            div_in = plan["amp_neutral"][0]
+        ovf_in = scaler._overflow_total_arr if plan["amp"] \
+            else plan["amp_neutral"][2]
         handles = plan["handles"]
-        new_ws, new_sts, gnorm = plan["fn"](
+        new_ws, new_sts, gnorm, new_scale, new_unsk, new_ovf = plan["fn"](
             [h.data for h in handles], [g.data for g in plan["grads"]],
             plan["states"], lr, wd, rescale, clip,
-            plan["lr_mults"], plan["wd_mults"])
+            plan["lr_mults"], plan["wd_mults"], scale_in, div_in,
+            unsk_in, ovf_in)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("trainer_fused")
         for h, w in zip(handles, new_ws):
@@ -445,7 +580,38 @@ class Trainer:
         plan["states"] = new_sts
         for p, s in zip(plan["active"], new_sts):
             self._fused_states[p.name] = s
+        if plan["amp"]:
+            # everything stays a lazy device scalar — zero per-step syncs
+            scaler._overflow_total_arr = new_ovf
+            if pending:
+                scaler._scale_arr = new_scale
+                scaler._unskipped_arr = new_unsk
+            if _obs.ENABLED:
+                _obs.record_amp_lazy(scaler._scale_arr, new_ovf)
         return gnorm
+
+    def _amp_eager_pending(self):
+        """Per-param fallback for a deferred ``scale_loss`` block: one
+        fused ``isfinite`` reduction decides skip-vs-update, then the
+        gradient BUFFERS are divided by the scale in one fused
+        executable (``amp.unscale``) — so user-visible grads and the
+        eager grad-norm probe see TRUE gradients, exactly like the
+        pre-deferral ``scale_loss.__exit__`` semantics. Returns True to
+        skip the update (overflow)."""
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        pending = getattr(self, "_amp_pending", False)
+        if scaler is None or not pending:
+            return False
+        active = [p for p in self._params
+                  if p.grad_req != "null" and p._data is not None]
+        overflow = scaler.has_overflow(active)  # fallback path: one sync
+        if not overflow and pending == "scaled":
+            from ..amp import unscale as _amp_unscale
+
+            _amp_unscale(self)  # buffers -> TRUE grads (one executable)
+        self._amp_pending = False
+        scaler.update_scale(overflow)
+        return overflow
 
     def _update(self, ignore_stale_grad=False):
         gnorm = self._maybe_fused_update()
@@ -457,6 +623,11 @@ class Trainer:
             # fast path must rebuild (and re-migrate states) or it would
             # silently rewind momentum to the flip-off point
             self._invalidate_fused()
+        if self._amp_eager_pending():
+            return None  # hard skip: same semantics as the fused path
+        return self._update_eager(ignore_stale_grad)
+
+    def _update_eager(self, ignore_stale_grad=False):
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

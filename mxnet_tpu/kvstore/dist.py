@@ -64,6 +64,17 @@ def _global_allreduce(raw):
     return _global_allreduce_impl(raw)
 
 
+def _accum_sum(a):
+    """Sum over the process axis with fp32 accumulation for bf16/fp16
+    payloads (the reduced-precision allreduce contract: low-precision
+    on the WIRE, full-precision in the ADD — a bf16 sum over many
+    workers loses low bits at every hop otherwise). Full-precision
+    inputs reduce exactly as before."""
+    if a.dtype in (jnp.bfloat16, jnp.float16):
+        return jnp.sum(a.astype(jnp.float32), axis=0).astype(a.dtype)
+    return jnp.sum(a, axis=0)
+
+
 def _global_allreduce_impl(raw):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -78,7 +89,7 @@ def _global_allreduce_impl(raw):
     )
     if _REDUCE["fn"] is None:
         _REDUCE["fn"] = jax.jit(
-            lambda a: jnp.sum(a, axis=0),
+            _accum_sum,
             out_shardings=NamedSharding(mesh, P()),
         )
     out = _REDUCE["fn"](g)

@@ -250,11 +250,16 @@ class KVStoreLocal(KVStoreBase):
             # full-gradient-set copy per step
             return False
         groups = raw_groups  # raw jax arrays: shape/dtype/nbytes below
-        sig = tuple((tuple(vs[0].shape), str(vs[0].dtype), len(vs))
-                    for vs in groups)
+        # reduced-precision wire format only matters when a real
+        # cross-process reduction runs (in-process there is no wire)
+        comm = "" if self._reduce_raw_is_identity() \
+            else _fusedstep.amp_allreduce_dtype()
+        key_sig = tuple((tuple(vs[0].shape), str(vs[0].dtype), len(vs))
+                        for vs in groups)
+        sig = (comm,) + key_sig
         plan = self._bucket_plans.get(sig)
         if plan is None:
-            plan = self._build_bucket_plan(sig)
+            plan = self._build_bucket_plan(key_sig, comm)
             self._bucket_plans[sig] = plan
             if _obs.ENABLED:
                 _obs.KV_BUCKET_BUILD_TOTAL.inc()
@@ -287,9 +292,15 @@ class KVStoreLocal(KVStoreBase):
                 o._set_data(self._place(m, o))
         return True
 
-    def _build_bucket_plan(self, sig):
+    def _build_bucket_plan(self, sig, comm=""):
         """Greedy dtype-homogeneous packing of keys into ~bucket_bytes
-        flat buckets, plus the compiled pack/unpack for this signature."""
+        flat buckets, plus the compiled pack/unpack for this signature.
+        ``comm`` (MXTPU_AMP_ALLREDUCE_DTYPE): non-empty casts float32
+        buckets down to that dtype inside the compiled pack — half the
+        wire bytes through ``_reduce_raw`` — and back to float32 inside
+        the compiled unpack (the reduction itself accumulates in fp32,
+        see ``dist._accum_sum``). In-graph both ways: no extra
+        dispatches, and ``_place`` still sees the storage dtype."""
         target = max(_fusedstep.bucket_bytes(), 1)
         shapes = [s for s, _, _ in sig]
         sizes = []
@@ -299,6 +310,7 @@ class KVStoreLocal(KVStoreBase):
                 n *= d
             sizes.append(n)
         buckets = []  # lists of key indices, concat order
+        bucket_dtypes = []  # storage dtype per bucket (dtype-homogeneous)
         open_per_dtype = {}  # dtype -> (bucket list, running bytes)
         for ki, (shape, dtype, _) in enumerate(sig):
             nbytes = sizes[ki] * jnp.dtype(dtype).itemsize
@@ -306,12 +318,15 @@ class KVStoreLocal(KVStoreBase):
             if idxs is None or (filled and filled + nbytes > target):
                 idxs, filled = [], 0
                 buckets.append(idxs)
+                bucket_dtypes.append(dtype)
             idxs.append(ki)
             open_per_dtype[dtype] = (idxs, filled + nbytes)
+        # only fp32 buckets are downcast: half/low dtypes gain nothing
+        cast_down = [bool(comm) and dt == "float32" for dt in bucket_dtypes]
 
         def pack(raw_groups):
             out = []
-            for idxs in buckets:
+            for bi, idxs in enumerate(buckets):
                 parts = []
                 for ki in idxs:
                     g = raw_groups[ki]
@@ -319,18 +334,23 @@ class KVStoreLocal(KVStoreBase):
                     for extra in g[1:]:
                         s = s + extra  # cross-device tree-sum per key
                     parts.append(s.reshape(-1))
-                out.append(parts[0] if len(parts) == 1
-                           else jnp.concatenate(parts))
+                b = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                if cast_down[bi]:
+                    b = b.astype(jnp.dtype(comm))
+                out.append(b)
             return tuple(out)
 
         def unpack(bucket_arrs):
             raws = [None] * len(sig)
             for bi, idxs in enumerate(buckets):
+                arr = bucket_arrs[bi]
+                if cast_down[bi]:
+                    arr = arr.astype(jnp.dtype(bucket_dtypes[bi]))
                 off = 0
                 for ki in idxs:
                     n = sizes[ki]
                     raws[ki] = jax.lax.slice(
-                        bucket_arrs[bi], (off,), (off + n,)
+                        arr, (off,), (off + n,)
                     ).reshape(shapes[ki])
                     off += n
             return tuple(raws)

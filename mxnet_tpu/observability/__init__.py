@@ -196,6 +196,16 @@ SHAPE_WOBBLE_TOTAL = _REGISTRY.counter(
     "CachedGraph shape-signature count exceeded MXTPU_RETRACE_BUDGET, "
     "by block — pad/bucket the inputs (docs/performance.md)")
 
+AMP_LOSS_SCALE = _REGISTRY.gauge(
+    "mxtpu_amp_loss_scale",
+    "current dynamic loss scale (fp16 AMP); under the fused step this "
+    "holds a LAZY device scalar that syncs only when read")
+AMP_OVERFLOW_TOTAL = _REGISTRY.gauge(
+    "mxtpu_amp_overflow_total",
+    "gradient-overflow (skip-update + scale-backoff) events since the "
+    "scaler was created — monotonic; a gauge, not a counter, so the "
+    "fused step can record the in-graph total as a lazy device scalar")
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)
@@ -266,6 +276,25 @@ def record_trainer_step(t0: float, t1: float, grad_norm=None):
         # keeps the latest lazy value; trace events just omit it)
         args["grad_norm"] = grad_norm
     _TRACER.record("trainer.step", cat="trainer", ts=t0, dur=dt, args=args)
+
+
+def record_amp_scale(scale, overflow_total, overflow: bool):
+    """One host-side loss-scale update (the eager AMP fallback — the
+    fused step sets the gauges lazily via ``record_amp_lazy`` instead
+    and emits no per-step trace event, keeping zero syncs)."""
+    AMP_LOSS_SCALE.set(scale)
+    AMP_OVERFLOW_TOTAL.set(float(overflow_total))
+    _TRACER.record("amp.scale_update", cat="amp", ts=_time.perf_counter(),
+                   dur=0.0, args={"scale": float(scale),
+                                  "overflow_total": int(overflow_total),
+                                  "overflow": bool(overflow)})
+
+
+def record_amp_lazy(scale, overflow_total):
+    """Fused-step AMP accounting: both values are device scalars stored
+    WITHOUT syncing (they materialize at gauge-read time)."""
+    AMP_LOSS_SCALE.set_lazy(scale)
+    AMP_OVERFLOW_TOTAL.set_lazy(overflow_total)
 
 
 def record_compile(block: str, dt: float, cause=None):
@@ -353,6 +382,10 @@ def summary() -> str:
         lines.append(f"  trainer: {int(steps)} steps, "
                      f"{mean_ms:.2f} ms/step mean, "
                      f"last grad norm {TRAINER_GRAD_NORM.value():.4g}")
+    if AMP_LOSS_SCALE._values or AMP_OVERFLOW_TOTAL._values:
+        lines.append(
+            f"  amp: loss scale {AMP_LOSS_SCALE.value():.4g}, "
+            f"{int(AMP_OVERFLOW_TOTAL.value())} overflows (skipped steps)")
     waits = ENGINE_WAIT_TOTAL.total()
     if waits:
         lines.append(

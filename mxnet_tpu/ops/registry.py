@@ -45,11 +45,32 @@ def _jitted(opdef: OpDef, kw_items: tuple):
     return jax.jit(lambda *xs: opdef.fn(*xs, **kwargs))
 
 
+#: bound to amp.policy._STATE when the amp package loads; None until
+#: then, so processes that never touch AMP pay one global read here
+_AMP_STATE = None
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_fp32(opdef: OpDef, kw_items: tuple):
+    """The AMP cast-policy variant of ``_jitted``: the op's fp32
+    upcast/downcast is traced into the SAME executable (zero extra
+    dispatches). A separate cache from ``_jitted`` so toggling AMP
+    switches executables without invalidating either."""
+    from ..amp.policy import wrap_fp32
+
+    kwargs = dict(kw_items)
+    return jax.jit(wrap_fp32(lambda *xs: opdef.fn(*xs, **kwargs)))
+
+
 def jitted(opdef: OpDef, kwargs: dict):
     """Cached XLA executable for this op + static attrs (eager passthrough
     for ops whose output shape is data-dependent)."""
     if not opdef.jit:
         return functools.partial(opdef.fn, **kwargs)
+    amp = _AMP_STATE
+    if amp is not None and amp["target_dtype"] is not None \
+            and opdef.name in amp["cast_ops"]:
+        return _jitted_fp32(opdef, tuple(sorted(kwargs.items())))
     return _jitted(opdef, tuple(sorted(kwargs.items())))
 
 

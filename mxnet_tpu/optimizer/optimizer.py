@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import functools
 
-import numpy as _np
 import jax
 import jax.numpy as jnp
 
@@ -117,8 +116,18 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    @staticmethod
+    def _is_low_precision(weight) -> bool:
+        """Dtypes that get an fp32 master under ``multi_precision``:
+        float16 (the reference's case) AND bfloat16 — the TPU-native
+        low-precision dtype needs masters for the same reason (8
+        mantissa bits lose small updates to rounding)."""
+        from ..amp.policy import is_low_precision_dtype
+
+        return is_low_precision_dtype(weight.dtype)
+
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_low_precision(weight):
             master = NDArray(weight.data.astype(jnp.float32), ctx=weight.ctx)
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
@@ -134,11 +143,11 @@ class Optimizer:
         raise NotImplementedError
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and self._is_low_precision(weight):
             master, st = state
             g32 = NDArray(grad.data.astype(jnp.float32), ctx=grad.ctx)
             self.update(index, master, g32, st)
-            weight._set_data(master.data.astype(jnp.float16))
+            weight._set_data(master.data.astype(weight.data.dtype))
         else:
             self.update(index, weight, grad, state)
 

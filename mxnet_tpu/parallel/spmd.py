@@ -175,6 +175,40 @@ def _nag_rule(hyper):
 _RULES = {"sgd": _sgd_rule, "nag": _nag_rule, "adam": _adam_rule,
           "adamw": _adam_rule, "lamb": _lamb_rule}
 
+_MP_SENTINEL = object()
+
+
+def mp_rule(rule_init, rule_update):
+    """fp32 master-weight wrapper around a ``_RULES`` pair (reference:
+    ``mp_sgd_update``/``mp_adam_update``): for bf16/fp16 params the
+    fp32 master copy becomes STATE LEAF 0, so it lives (and is donated)
+    in the same optimizer-state pytree as the moments — updates
+    accumulate in the master across steps and the stored weight is a
+    rounded VIEW of it, instead of being re-derived from the rounded
+    weight every step (which loses updates smaller than one bf16 ulp).
+    fp32 params pass through untouched, so one wrapped rule serves a
+    mixed-precision param set."""
+
+    from ..amp.policy import is_low_precision_dtype
+
+    def init(w):
+        if not is_low_precision_dtype(w.dtype):
+            return rule_init(w)
+        master = w.astype(jnp.float32)
+        return (master,) + tuple(rule_init(master))
+
+    def update(w, g, state, lr, wd=_MP_SENTINEL):
+        kw = {} if wd is _MP_SENTINEL else {"wd": wd}
+        if not is_low_precision_dtype(w.dtype):
+            return rule_update(w, g, state, lr, **kw)
+        master, inner = state[0], tuple(state[1:])
+        new_master, new_inner = rule_update(
+            master, g.astype(jnp.float32), inner, lr, **kw)
+        return new_master.astype(w.dtype), \
+            (new_master,) + tuple(new_inner)
+
+    return init, update
+
 
 class SPMDTrainStep:
     """One-executable train step for a Gluon block over a mesh.
@@ -185,7 +219,8 @@ class SPMDTrainStep:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, batch_axis="dp", param_sharding=None,
-                 shard_opt_states=False, grad_dtype=None, donate=True):
+                 shard_opt_states=False, grad_dtype=None, donate=True,
+                 multi_precision=False):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -196,6 +231,11 @@ class SPMDTrainStep:
                 f"SPMD step supports {sorted(_RULES)}; got {optimizer}. "
                 "Use gluon.Trainer for other optimizers.")
         self._rule_init, self._rule_update = _RULES[optimizer](hyper)
+        if multi_precision:
+            # bf16/fp16 params carry fp32 masters as state leaf 0 —
+            # sharded/donated with the rest of the opt-state pytree
+            self._rule_init, self._rule_update = mp_rule(
+                self._rule_init, self._rule_update)
         self._param_sharding = param_sharding or {}
         self._shard_opt_states = shard_opt_states
         self._donate = donate

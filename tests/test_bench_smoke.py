@@ -29,6 +29,8 @@ def test_bench_emits_driver_contract(tmp_path):
     # keep the smoke from overwriting the repo's committed bench records
     env["BENCH_PR3_OUT"] = str(tmp_path / "BENCH_pr3.json")
     env["BENCH_PR4_OUT"] = str(tmp_path / "BENCH_pr4.json")
+    env["BENCH_PR5_OUT"] = str(tmp_path / "BENCH_pr5.json")
+    env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     res = subprocess.run(
         [sys.executable, "-c", _RUNNER.format(root=ROOT)],
         env=env, capture_output=True, text=True, timeout=600)
@@ -48,6 +50,22 @@ def test_bench_emits_driver_contract(tmp_path):
     assert any("allreduce" in n for n in names)
     assert any(n.startswith("input_pipeline_prefetch") for n in names)
     # warm persistent-compile-cache start must skip recompilation
+    # (probe failures land on bench stderr — surface them on assert)
     warm = [r for r in recs
             if r["metric"].startswith("compile_cache_warm")]
-    assert warm and warm[0]["cache_misses"] == 0, warm
+    assert warm and warm[0]["cache_misses"] == 0, \
+        (warm, res.stderr[-2000:])
+    # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
+    # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
+    amp_recs = [r for r in recs
+                if r["metric"].startswith("train_step_amp_bf16")]
+    assert amp_recs, names
+    assert amp_recs[0]["fp16_overflow_recovered"] is True, amp_recs
+    assert "speedup_vs_fp32" in amp_recs[0]
+    pr5 = json.load(open(tmp_path / "BENCH_pr5.json"))
+    assert pr5["scenario"] == "amp" and pr5["fp16_overflow_recovered"]
+    # run-status record (VERDICT r5 hardening): rc 0 + every scenario
+    # listed as completed, failures (none here) keyed by scenario
+    status = json.load(open(tmp_path / "BENCH_STATUS.json"))
+    assert status["rc"] == 0, status
+    assert "amp" in status["completed"] and not status["failed"], status

@@ -242,6 +242,101 @@ def test_bucketed_variable_length_compiles_bounded_executables():
         obs.reset()
 
 
+def _dispatches_per_step_amp(n_hidden, target_dtype):
+    """The AMP variant of the dispatch-count harness: cast policy on,
+    convert_model'd net, fp32 masters (and for fp16 the in-graph loss
+    scaler). The whole step must still be O(1) executables."""
+    from mxnet_tpu import amp
+
+    prev_obs = obs.set_enabled(True)
+    amp.init(target_dtype)
+    try:
+        mx.random.seed(0)
+        net = _build_mlp(n_hidden)
+        amp.convert_model(net)
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9,
+                            "multi_precision": True}, kvstore=None)
+        if target_dtype == "float16":
+            amp.init_trainer(tr)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        X = mx.nd.array(np.random.RandomState(1).randn(4, 8)
+                        .astype(np.float32)).astype(target_dtype)
+        Y = mx.nd.array(np.random.RandomState(2).randint(0, 3, (4,))
+                        .astype(np.float32))
+
+        def one():
+            with autograd.record():
+                l = loss_fn(net(X), Y)
+                if target_dtype == "float16":
+                    with amp.scale_loss(l, tr) as sl:
+                        sl.backward()
+            if target_dtype != "float16":
+                l.backward()
+            tr.step(4)
+
+        one()
+        one()  # warmup: compile, build the fused plan
+        assert tr._fused not in (False, None)
+        obs.reset()
+        one()
+        return obs.XLA_DISPATCH_TOTAL.total()
+    finally:
+        amp.disable()
+        obs.set_enabled(prev_obs)
+        obs.reset()
+
+
+@pytest.mark.parametrize("target_dtype", ["bfloat16", "float16"])
+def test_dispatch_count_constant_with_amp(target_dtype):
+    """Acceptance contract: amp.init() + MXTPU_FUSED_STEP keeps the
+    train step O(1) XLA dispatches — the cast policy lands inside the
+    traced executables and loss scaling lives inside the fused update,
+    so AMP adds ZERO dispatches over the fp32 fast path."""
+    small = _dispatches_per_step_amp(1, target_dtype)
+    large = _dispatches_per_step_amp(6, target_dtype)
+    assert small == large, (small, large)
+    assert large < 40, large
+
+
+def test_fused_multi_precision_parity_bf16():
+    """Fused mp update == eager mp per-param loop on a bf16 net (both
+    keep fp32 masters; the stored weights must agree to bf16 ulp)."""
+    from mxnet_tpu import amp
+
+    def run(fused):
+        prev = fusedstep.set_enabled(fused)
+        amp.init("bfloat16")
+        try:
+            mx.random.seed(0)
+            np.random.seed(0)
+            net = _build_mlp(1)
+            amp.convert_model(net)
+            if fused:
+                net.hybridize()
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1, "momentum": 0.9,
+                                "multi_precision": True}, kvstore=None)
+            X = mx.nd.array(np.random.RandomState(1).rand(8, 8)
+                            .astype(np.float32)).astype("bfloat16")
+            for _ in range(5):
+                with autograd.record():
+                    l = (net(X) ** 2).sum()
+                l.backward()
+                tr.step(8)
+            return [w.astype(np.float32) for w in _sorted_weights(net)], tr
+        finally:
+            amp.disable()
+            fusedstep.set_enabled(prev)
+
+    wf, trf = run(True)
+    we, _ = run(False)
+    assert trf._fused not in (False, None), "mp bf16 must ride the fused path"
+    for a, b in zip(wf, we):
+        np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+
+
 def test_grad_norm_gauge_is_lazy_with_fused_step():
     """The fused step folds the grad-norm gauge into the update
     executable: Trainer.step records a device scalar (no sync); the

@@ -108,6 +108,28 @@ def render_table(events, cat=None, sort_by="total", ascending=False):
     return "\n".join(lines)
 
 
+def render_amp(events):
+    """Mixed-precision summary from ``amp.scale_update`` events (the
+    trace-side view of the ``mxtpu_amp_loss_scale`` /
+    ``mxtpu_amp_overflow_total`` gauges). Crash-proof by construction:
+    absent series -> empty string, malformed args render as '-'."""
+    evs = [ev for ev in events if ev.get("name") == "amp.scale_update"]
+    if not evs:
+        return ""
+
+    def arg(ev, key):
+        args = ev.get("args")
+        return args.get(key, "-") if isinstance(args, dict) else "-"
+
+    overflows = sum(1 for ev in evs if arg(ev, "overflow") is True)
+    last = evs[-1]
+    return "\n".join([
+        "", "AMP loss scaling:",
+        f"  scale updates: {len(evs)}, overflows (skipped steps): "
+        f"{overflows}, final scale: {arg(last, 'scale')}, "
+        f"overflow total: {arg(last, 'overflow_total')}"])
+
+
 def render_steps(events):
     """Per-step timeline of trainer.step spans, when present."""
     steps = [ev for ev in events if ev.get("name") == "trainer.step"]
@@ -142,6 +164,9 @@ def main(argv=None):
     events = load_events(source)
     print(render_table(events, cat=args.cat, sort_by=args.sort,
                        ascending=args.ascending))
+    amp = render_amp(events)
+    if amp:
+        print(amp)
     if args.steps:
         out = render_steps(events)
         if out:
