@@ -425,6 +425,123 @@ def bench_train_step(backend):
         f.write("\n")
 
 
+def bench_superstep(backend):
+    """PR6 tentpole: K-step on-device superstep vs the one-step fused
+    loop. Leg 1 (K=1 = today's behavior) runs the idiomatic fused Gluon
+    loop — the host re-enters every step to feed the batch and tick
+    telemetry. Leg 2 compiles K full fwd+bwd+update iterations into ONE
+    lax.scan dispatch consuming stacked batch slots (gluon.Superstep),
+    so the host touches the loop once per K steps. Telemetry stays on
+    for BOTH legs (identical overhead) so the mxtpu_xla_dispatch_total
+    deltas measure real dispatches/step. Emits BENCH_pr6.json."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, engine, gluon, observability as obs
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.data.prefetcher import stack_batches
+
+    n_layers = int(os.environ.get("BENCH_TS_LAYERS", "6"))
+    width = int(os.environ.get("BENCH_TS_WIDTH",
+                               "256" if backend != "cpu" else "64"))
+    batch = int(os.environ.get("BENCH_TS_BATCH",
+                               "64" if backend != "cpu" else "16"))
+    k = int(os.environ.get("BENCH_SS_K", "8"))
+    steps = int(os.environ.get("BENCH_SS_STEPS",
+                               "200" if backend != "cpu" else "48"))
+    steps = max(k, steps - steps % k)  # whole supersteps, at least one
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rx = np.random.RandomState(0)
+    ry = np.random.RandomState(1)
+    Xs = [mx.nd.array(rx.rand(batch, width).astype(np.float32))
+          for _ in range(k)]
+    Ys = [mx.nd.array(ry.randint(0, 10, (batch,)).astype(np.float32))
+          for _ in range(k)]
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        for _ in range(n_layers):
+            net.add(nn.Dense(width, activation="relu", in_units=width))
+        net.add(nn.Dense(10, in_units=width))
+        net.initialize(init=mx.initializer.Xavier())
+        net.hybridize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.05, "momentum": 0.9},
+                           kvstore=None)
+        return net, tr
+
+    prev_obs = obs.set_enabled(True)
+    try:
+        def dispatches():
+            return obs.XLA_DISPATCH_TOTAL.total()
+
+        # K=1: today's one-step fused loop
+        net, tr = build()
+
+        def one(i):
+            with autograd.record():
+                l = loss_fn(net(Xs[i % k]), Ys[i % k])
+            l.backward()
+            tr.step(batch)
+            return l
+
+        one(0)
+        engine.wait(one(1).data)  # warmup: compile fwd/bwd/update
+        c0 = dispatches()
+        t0 = time.perf_counter()
+        l = None
+        for i in range(steps):
+            l = one(i)
+        engine.wait(l.data)
+        k1_sps = steps / (time.perf_counter() - t0)
+        d_k1 = (dispatches() - c0) / steps
+
+        # K=k: whole-program superstep, one dispatch per K steps
+        net2, tr2 = build()
+        sstep = gluon.Superstep(net2, loss_fn, tr2, k=k)
+        xs, ys = stack_batches(Xs), stack_batches(Ys)
+        engine.wait(sstep.step(xs, ys, batch).data)  # warm: capture+compile
+        c0 = dispatches()
+        t0 = time.perf_counter()
+        l = None
+        for _ in range(steps // k):
+            l = sstep.step(xs, ys, batch)
+        engine.wait(l.data)
+        ss_sps = steps / (time.perf_counter() - t0)
+        d_kk = (dispatches() - c0) / steps
+    finally:
+        obs.set_enabled(prev_obs)
+
+    reduction = d_k1 / max(d_kk, 1e-9)
+    tag = f"mlp{n_layers}x{width}_bs{batch}_{backend}"
+    _emit(f"train_step_superstep_k1_{tag}", k1_sps, "steps/sec", None,
+          step_ms=1e3 / k1_sps, steps=steps,
+          dispatches_per_step=round(d_k1, 3))
+    _emit(f"train_step_superstep_k{k}_{tag}", ss_sps, "steps/sec", None,
+          step_ms=1e3 / ss_sps, steps=steps,
+          speedup_vs_k1=round(ss_sps / k1_sps, 3),
+          dispatches_per_step=round(d_kk, 3),
+          dispatch_reduction=round(reduction, 1))
+    out_path = os.environ.get(
+        "BENCH_PR6_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_pr6.json"))
+    with open(out_path, "w") as f:
+        json.dump({"scenario": "superstep", "backend": backend,
+                   "config": {"layers": n_layers, "width": width,
+                              "batch": batch, "steps": steps, "k": k},
+                   "k1_steps_per_sec": round(k1_sps, 2),
+                   "superstep_steps_per_sec": round(ss_sps, 2),
+                   "superstep_speedup_vs_k1": round(ss_sps / k1_sps, 3),
+                   "dispatches_per_step_k1": round(d_k1, 3),
+                   "dispatches_per_step_superstep": round(d_kk, 3),
+                   "dispatch_reduction": round(reduction, 1)}, f,
+                  indent=2)
+        f.write("\n")
+
+
 def bench_amp(backend):
     """PR5 tentpole: end-to-end mixed precision on the matmul-heavy
     train_step config — the same idiomatic fused Gluon loop run in fp32
@@ -851,6 +968,7 @@ def main():
     suite = [("allreduce", bench_allreduce),
              ("flash_attention", bench_flash_attention),
              ("train_step", bench_train_step),
+             ("superstep", bench_superstep),
              ("amp", bench_amp),
              ("input_pipeline", bench_input_pipeline),
              ("bert", bench_bert),

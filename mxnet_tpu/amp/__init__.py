@@ -22,6 +22,17 @@ paths switch). ``Optimizer.create_state_multi_precision`` covers
 Reduced-precision gradient allreduce: ``MXTPU_AMP_ALLREDUCE_DTYPE=bfloat16``
 ships fp32 gradient buckets over the wire in bf16 (fp32 accumulation) —
 see ``kvstore/local.py`` and ``docs/performance.md``.
+
+K-step superstep (``gluon.Superstep``, PR 6): the scaler state rides the
+scan CARRY of the K-step executable — scale/unscale, the all-finite
+check, the skip decision and backoff/growth all run PER ITERATION inside
+the scan, so one overflowing microbatch skips only its own iteration
+(the other K−1 still apply) and the scale adjusts within the superstep.
+The host applies the resulting scale/overflow counters back to the
+scaler once per K steps; ``loss_scale``/``overflow_total`` therefore
+update with K-step cadence (docs/observability.md). Don't leave a
+``scale_loss`` block pending across a superstep dispatch — the superstep
+scales in-graph and never consumes the deferred flag.
 """
 
 from __future__ import annotations

@@ -90,6 +90,30 @@ def amp_allreduce_dtype() -> str:
     return v
 
 
+#: K-step superstep: how many full fwd+bwd+update iterations one
+#: gluon.Superstep dispatch runs on device (MXTPU_SUPERSTEP_K, default
+#: 1 = today's one-step behavior). Mutable at runtime for tests/bench.
+SUPERSTEP_K = max(1, int(getenv("MXTPU_SUPERSTEP_K", 1, dtype=int)))
+
+
+def superstep_k() -> int:
+    """Default iteration count per on-device training superstep
+    (``MXTPU_SUPERSTEP_K``). 1 means every ``gluon.Superstep`` dispatch
+    covers a single step — exactly the PR-3/5 fused behavior, just
+    captured whole-program. Raising K amortizes the per-step host round
+    trip (batch feed, loss-scale bookkeeping, telemetry) over K steps;
+    see docs/performance.md "superstep" for choosing K."""
+    return SUPERSTEP_K
+
+
+def set_superstep_k(k: int) -> int:
+    """Set the default superstep K at runtime; returns the previous
+    value. Existing Superstep objects keep the K they were built with."""
+    global SUPERSTEP_K
+    prev, SUPERSTEP_K = SUPERSTEP_K, max(1, int(k))
+    return prev
+
+
 _RETRACE_BUDGET_DEFAULT = 8
 
 

@@ -14,6 +14,7 @@ from .sampler import (  # noqa: F401
     IntervalSampler,
 )
 from .dataloader import DataLoader  # noqa: F401
-from .prefetcher import DevicePrefetcher, prefetch_depth  # noqa: F401
+from .prefetcher import (DevicePrefetcher, SuperstepRing,  # noqa: F401
+                         prefetch_depth, stack_batches)  # noqa: F401
 from .shape_guard import SequenceBucketer, pad_batch  # noqa: F401
 from . import vision  # noqa: F401

@@ -244,6 +244,162 @@ class DevicePrefetcher:
             pass
 
 
+def _stack_leaves(batches):
+    """Leaf-wise device stack of structurally identical batches into
+    ``[K, ...]`` arrays (tuple/list/dict/NDArray structure preserved).
+    The stack runs on device over already-staged arrays — one fused
+    concat per leaf, counted as a ``superstep_stage`` dispatch."""
+    import jax.numpy as jnp
+
+    first = batches[0]
+    if isinstance(first, tuple) and hasattr(first, "_fields"):  # namedtuple
+        return type(first)(*(_stack_leaves([b[i] for b in batches])
+                             for i in range(len(first))))
+    if isinstance(first, (list, tuple)):
+        return type(first)(_stack_leaves([b[i] for b in batches])
+                           for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _stack_leaves([b[k] for b in batches]) for k in first}
+    if first.__class__.__name__ == "DataBatch" and hasattr(first, "data"):
+        from ...io.io import DataBatch
+
+        return DataBatch(
+            data=_stack_leaves([b.data for b in batches]),
+            label=_stack_leaves([b.label for b in batches]),
+            pad=first.pad, index=first.index, bucket_key=first.bucket_key,
+            provide_data=first.provide_data,
+            provide_label=first.provide_label)
+    if isinstance(first, NDArray):
+        raws = [b.data for b in batches]
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("superstep_stage")
+        return NDArray(jnp.stack(raws), ctx=first.ctx)
+    if hasattr(first, "shape"):
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("superstep_stage")
+        return jnp.stack([jnp.asarray(b) for b in batches])
+    if isinstance(first, (int, float, str, bool, type(None))):
+        return first  # scalar metadata: assumed slot-invariant
+    raise TypeError(f"cannot stack batch leaf of type {type(first)!r}")
+
+
+def stack_batches(batches):
+    """Stack a list of structurally identical batches into one batch
+    whose every array leaf gains a leading ``[K]`` slot axis — the
+    operand block one K-step superstep dispatch consumes. Raises
+    ``ValueError`` on shape/structure mismatch (unpadded final batches:
+    stabilize with ``DataLoader(last_batch="pad")`` / bucketing first)."""
+    if not batches:
+        raise ValueError("stack_batches: empty batch list")
+    try:
+        return _stack_leaves(batches)
+    except Exception as e:
+        raise ValueError(
+            f"stack_batches: batches are not shape/structure stable "
+            f"({e}); pad partial batches and bucket variable-length "
+            f"inputs (docs/performance.md 'input pipeline')") from e
+
+
+class SuperstepRing:
+    """K-deep device staging ring feeding a training superstep.
+
+    Wraps any batch source in a :class:`DevicePrefetcher` whose queue is
+    at least ``k`` deep, so the producer thread stages (device_put / mesh
+    ``shard_batch``) the NEXT superstep's K slots while the previous
+    superstep executes on device. Iterating yields ``(batch, k_actual)``
+    groups: ``k_actual == k`` means ``batch`` is the stacked ``[K, ...]``
+    operand block; a final short group (source exhausted mid-ring) is
+    yielded as the raw LIST of staged batches with ``k_actual < k`` so
+    the consumer can single-step the tail.
+
+    Error/close contract is the prefetcher's: a source/transfer exception
+    propagates from ``next()`` (after any full groups already staged),
+    and ``close()`` is idempotent and joins the producer thread.
+
+    >>> ring = SuperstepRing(loader, k=8, device=mx.tpu())
+    >>> for group, n in ring:
+    ...     if n == ring.k:
+    ...         sstep.step(*group, batch_size)   # one dispatch, 8 steps
+    """
+
+    def __init__(self, source, k, device=None, mesh=None, depth=None):
+        self.k = max(1, int(k))
+        if isinstance(source, DevicePrefetcher):
+            if device is not None or mesh is not None or depth is not None:
+                # silently dropping these would leave batches on the
+                # wrong device / the queue too shallow with no signal
+                raise ValueError(
+                    "SuperstepRing: device/mesh/depth apply only when "
+                    "the ring builds its own prefetcher — configure "
+                    "them on the DevicePrefetcher you passed in")
+            if source._depth < self.k:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "SuperstepRing: wrapped DevicePrefetcher depth %d "
+                    "< k=%d — the next superstep's slots cannot all "
+                    "stage while the current one runs (lost overlap); "
+                    "build the prefetcher with depth >= k",
+                    source._depth, self.k)
+            self._pf = source
+            self._own = False
+        else:
+            # queue depth covers one full superstep plus the configured
+            # lookahead, so staging the next K slots overlaps execution
+            d = depth if depth is not None \
+                else self.k + (prefetch_depth() or _DEPTH_DEFAULT)
+            self._pf = DevicePrefetcher(source, device=device, mesh=mesh,
+                                        depth=d)
+            self._own = True
+        self._err = None
+
+    def __iter__(self):
+        iter(self._pf)
+        return self
+
+    def __next__(self):
+        if self._err is not None:
+            # a source/transfer error interrupted the previous group:
+            # its staged batches were delivered, now the error surfaces
+            err, self._err = self._err, None
+            raise err
+        group = []
+        for _ in range(self.k):
+            try:
+                group.append(next(self._pf))
+            except StopIteration:
+                break
+            except Exception as e:
+                # producer/transfer errors: deliver already-staged work
+                # first, re-raise on the NEXT group so no staged batch
+                # is silently dropped. KeyboardInterrupt/SystemExit are
+                # NOT deferred — an interrupt must not train a tail
+                # group first.
+                if not group:
+                    raise
+                self._err = e
+                break
+        if not group:
+            raise StopIteration
+        if self._err is not None or len(group) < self.k:
+            return group, len(group)  # short tail: consumer single-steps
+        return stack_batches(group), self.k
+
+    def reset(self):
+        self._err = None
+        self._pf.reset()
+
+    def close(self):
+        if self._own:
+            self._pf.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 def wrap_for_fit(source, ctx=None, depth=None):
     """Auto-wrap a fit-loop's train data in a DevicePrefetcher (the
     estimator / ``Module.fit`` integration seam). Returns ``source``

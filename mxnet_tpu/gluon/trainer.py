@@ -13,20 +13,102 @@ passed as jit OPERANDS (not trace constants, so hyperparameter changes
 never retrace), weight and optimizer-state buffers donated to XLA, and
 the telemetry grad-norm gauge folded into the same executable (no
 per-step device sync). See docs/performance.md for eligibility.
+
+K-step superstep (``Superstep``, ``MXTPU_SUPERSTEP_K``): the whole-
+program generalization — forward + backward + update for K DISTINCT
+batches compiled into one ``lax.scan`` executable whose carry is the
+donated weights + optimizer state + AMP loss-scaler state, consuming
+stacked ``[K, ...]`` batch slots staged ahead by
+``gluon.data.SuperstepRing``. The host touches the training loop once
+per K steps. See docs/performance.md "superstep".
 """
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
+from .. import autograd
 from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from .. import optimizer as opt
+from .. import random as _random
 from ..base import MXNetError
 from ..kvstore import create as _create_kvstore
 from ..kvstore.base import KVStoreBase
+from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
+
+
+# -- shared fused-update numerics ------------------------------------------
+# Traced inside BOTH the one-step fused executable and the superstep scan
+# body: the two paths are parity-pinned, so the per-iteration arithmetic
+# must live in exactly one place (like _fused_rules/_fused_sig for
+# eligibility/staleness).
+
+def _all_finite(gs):
+    """ONE fused all-finite reduction over a gradient list (the fp16
+    skip-update predicate)."""
+    finite = jnp.bool_(True)
+    for g in gs:
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+    return finite
+
+
+def _apply_fused_update(ws, gs, sts, rule_update, lr, wd, rescale, clip,
+                        lr_mults, wd_mults, has_clip, has_amp, with_gnorm,
+                        finite, unscale_div):
+    """Multi-tensor optimizer update for one iteration: in-graph grad
+    norm (pre-rescale, for gauge parity with the eager probe), the fp16
+    f32-upcast BEFORE the combined (1/batch)/loss_scale factor touches
+    the grad (at batch 512 x scale 2^16 that factor is 3e-8, below
+    fp16's 6e-8 subnormal floor — applied in g.dtype it rounds to
+    literal 0 and every update silently vanishes), clip, the pytree
+    rule, and the ``where``-based fp16 skip (a non-finite gradient set
+    leaves the weights AND the whole state pytree untouched — no NaN
+    can reach the (master) weights). ``rescale`` arrives with any
+    unscale factor already folded in; ``unscale_div`` only corrects the
+    reported grad norm (the buffers hold SCALED grads under deferred
+    scale_loss)."""
+    new_ws, new_sts, sq = [], [], []
+    for i, (w, g, s) in enumerate(zip(ws, gs, sts)):
+        if with_gnorm:
+            g32 = g.astype(jnp.float32)
+            sq.append(jnp.vdot(g32, g32))
+        if has_amp:
+            g = g.astype(jnp.float32)
+        g = g * rescale.astype(g.dtype)
+        if has_clip:
+            c = clip.astype(g.dtype)
+            g = jnp.clip(g, -c, c)
+        w2, s2 = rule_update(w, g, s, lr * lr_mults[i],
+                             wd=wd * wd_mults[i])
+        if has_amp:
+            w2 = jnp.where(finite, w2, w)
+            s2 = tuple(jnp.where(finite, a, b) for a, b in zip(s2, s))
+        new_ws.append(w2)
+        new_sts.append(s2)
+    gnorm = jnp.sqrt(sum(sq)) if sq else jnp.float32(0.0)
+    if has_amp:
+        gnorm = gnorm / unscale_div
+    return new_ws, new_sts, gnorm
+
+
+def _amp_scale_step(finite, scale, unskipped, ovf_total, factor, window):
+    """In-graph dynamic loss-scale adjustment (the device twin of
+    ``LossScaler.update_scale``): backoff on overflow (floor 1.0), grow
+    after ``window`` clean updates, count overflows."""
+    ovf = jnp.logical_not(finite)
+    unsk1 = unskipped + 1
+    grow = unsk1 >= window
+    scale = jnp.where(ovf, jnp.maximum(scale / factor, 1.0),
+                      jnp.where(grow, scale * factor, scale))
+    unskipped = jnp.where(jnp.logical_or(ovf, grow),
+                          jnp.zeros_like(unskipped), unsk1)
+    ovf_total = ovf_total + ovf.astype(ovf_total.dtype)
+    return scale, unskipped, ovf_total
 
 
 class Trainer:
@@ -138,8 +220,6 @@ class Trainer:
         if not _obs.ENABLED:
             self._step_impl(batch_size, ignore_stale_grad)
             return
-        import time
-
         t0 = time.perf_counter()
         gnorm = self._step_impl(batch_size, ignore_stale_grad)
         t1 = time.perf_counter()  # span excludes any probe device sync
@@ -241,34 +321,21 @@ class Trainer:
         self._fused = self._build_fused_plan(active)
         return self._fused
 
-    def _build_fused_plan(self, active):
+    def _fused_rules(self):
+        """Shared optimizer-eligibility gate + pytree rule assembly for
+        the one-step fused plan AND the K-step superstep (the two must
+        stay in lockstep: a new rule or restriction added here applies
+        to both). Returns ``(name, hyper, rule_init, rule_update)``, or
+        a decline-reason string when the optimizer has no fused rule."""
         o = self._optimizer
         name = type(o).__name__.lower()
-
-        def no(reason):
-            _fusedstep.log_fallback("trainer", reason)
-            return False
-
-        # (the MXTPU_FUSED_STEP switch is checked once, in
-        # _maybe_fused_update — a disabled flag never reaches here)
         if name not in self._FUSABLE:
-            return no(f"optimizer '{name}' has no fused pytree rule")
+            return f"optimizer '{name}' has no fused pytree rule"
         if name == "lamb" and (
                 getattr(o, "lower_bound", None) is not None
                 or getattr(o, "upper_bound", None) is not None
                 or not getattr(o, "bias_correction", True)):
-            return no("lamb with bounds/bias_correction=False")
-        if any(p._stype != "default" or p._grad_stype != "default"
-               for p in active):
-            return no("sparse parameters/gradients")
-        # real per-context count: a param replicated on >1 device updates
-        # via the update-once-broadcast path, not the fused executable
-        if any(len(p._data) != 1 for p in active):
-            return no("multi-device parameters")
-        handles = [p.data() for p in active]
-        grads = [h.grad for h in handles]
-        if any(g is None for g in grads):
-            return no("gradient buffers not attached")
+            return "lamb with bounds/bias_correction=False"
 
         from ..parallel.spmd import _RULES, mp_rule
 
@@ -281,6 +348,47 @@ class Trainer:
             # leaf 0 in the donated pytree (the multi-tensor analog of
             # the reference's mp_sgd/mp_adam kernels)
             rule_init, rule_update = mp_rule(rule_init, rule_update)
+        return name, hyper, rule_init, rule_update
+
+    def _fused_sig(self):
+        """Hyperparameter signature shared by BOTH compiled-plan
+        staleness guards (one-step fused update and superstep): any
+        change here means the executable's trace constants are stale
+        and the plan must rebuild."""
+        o = self._optimizer
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        return (o.clip_gradient is not None,
+                type(o).__name__.lower(),
+                _obs.ENABLED,
+                o.multi_precision,
+                scaler is not None,
+                (scaler._factor, scaler._window)
+                if scaler is not None else None)
+
+    def _build_fused_plan(self, active):
+        o = self._optimizer
+
+        def no(reason):
+            _fusedstep.log_fallback("trainer", reason)
+            return False
+
+        # (the MXTPU_FUSED_STEP switch is checked once, in
+        # _maybe_fused_update — a disabled flag never reaches here)
+        rules = self._fused_rules()
+        if isinstance(rules, str):
+            return no(rules)
+        name, hyper, rule_init, rule_update = rules
+        if any(p._stype != "default" or p._grad_stype != "default"
+               for p in active):
+            return no("sparse parameters/gradients")
+        # real per-context count: a param replicated on >1 device updates
+        # via the update-once-broadcast path, not the fused executable
+        if any(len(p._data) != 1 for p in active):
+            return no("multi-device parameters")
+        handles = [p.data() for p in active]
+        grads = [h.grad for h in handles]
+        if any(g is None for g in grads):
+            return no("gradient buffers not attached")
         idx = [self._param2idx[p.name] for p in active]
         states = [self._restore_fused_state(name, p, i, h.data, rule_init)
                   for p, i, h in zip(active, idx, handles)]
@@ -305,71 +413,37 @@ class Trainer:
         # arithmetic — the two diverge exactly when amp.unscale ran
         def fused(ws, gs, sts, lr, wd, rescale, clip, lr_mults, wd_mults,
                   scale, unscale_div, unskipped, ovf_total):
+            finite = _all_finite(gs) if has_amp else None
             if has_amp:
-                finite = jnp.bool_(True)
-                for g in gs:
-                    finite = jnp.logical_and(
-                        finite, jnp.all(jnp.isfinite(g)))
                 rescale = rescale / unscale_div  # unscale rides the rescale
-            new_ws, new_sts, sq = [], [], []
-            for i, (w, g, s) in enumerate(zip(ws, gs, sts)):
-                if with_gnorm:
-                    g32 = g.astype(jnp.float32)
-                    sq.append(jnp.vdot(g32, g32))  # pre-rescale: parity
-                if has_amp:
-                    # upcast BEFORE the combined (1/batch)/loss_scale
-                    # factor touches the grad: at batch 512 x scale 2^16
-                    # that factor is 3e-8, below fp16's 6e-8 subnormal
-                    # floor — applied in g.dtype it rounds to literal 0
-                    # and every update silently vanishes
-                    g = g.astype(jnp.float32)
-                g = g * rescale.astype(g.dtype)    # with _grad_norm
-                if has_clip:
-                    c = clip.astype(g.dtype)
-                    g = jnp.clip(g, -c, c)
-                w2, s2 = rule_update(w, g, s, lr * lr_mults[i],
-                                     wd=wd * wd_mults[i])
-                if has_amp:
-                    # skip-update: a non-finite gradient set leaves the
-                    # weights AND the whole state pytree untouched — no
-                    # NaN can reach the (master) weights
-                    w2 = jnp.where(finite, w2, w)
-                    s2 = tuple(jnp.where(finite, a, b)
-                               for a, b in zip(s2, s))
-                new_ws.append(w2)
-                new_sts.append(s2)
-            gnorm = jnp.sqrt(sum(sq)) if sq else jnp.float32(0.0)
+            new_ws, new_sts, gnorm = _apply_fused_update(
+                ws, gs, sts, rule_update, lr, wd, rescale, clip,
+                lr_mults, wd_mults, has_clip, has_amp, with_gnorm,
+                finite, unscale_div)
             if has_amp:
-                # the buffers hold SCALED grads under deferred
-                # scale_loss; report the TRUE norm (old scale_loss
-                # unscaled the buffers before any norm read)
-                gnorm = gnorm / unscale_div
-            if has_amp:
-                ovf = jnp.logical_not(finite)
-                unsk1 = unskipped + 1
-                grow = unsk1 >= amp_window
-                scale = jnp.where(
-                    ovf, jnp.maximum(scale / amp_factor, 1.0),
-                    jnp.where(grow, scale * amp_factor, scale))
-                unskipped = jnp.where(jnp.logical_or(ovf, grow),
-                                      jnp.zeros_like(unskipped), unsk1)
-                ovf_total = ovf_total + ovf.astype(ovf_total.dtype)
+                scale, unskipped, ovf_total = _amp_scale_step(
+                    finite, scale, unskipped, ovf_total,
+                    amp_factor, amp_window)
             return new_ws, new_sts, gnorm, scale, unskipped, ovf_total
 
         fused_jit = jax.jit(
             fused,
             donate_argnums=(0, 2) if _fusedstep.DONATE else ())
+        # publish the seeded states: ownership lives in _fused_states
+        # from build time on, so the superstep (and a rebuilt plan)
+        # migrate from here by IDENTITY instead of resetting momentum
+        for p, st in zip(active, states):
+            self._fused_states[p.name] = st
         return {"fn": fused_jit, "active": active, "handles": handles,
                 "grads": grads, "states": states, "idx": idx, "name": name,
+                "rule_init": rule_init, "sig": self._fused_sig(),
                 "has_clip": has_clip, "mults": None,
                 "lr_mults": None, "wd_mults": None,
                 # freezing/unfreezing params (grad_req mutation) and a
                 # multi_precision toggle change WHICH params the plan
                 # covers — the staleness guard compares this signature
                 "req_sig": tuple(p.grad_req for p in self._params),
-                "multi_precision": o.multi_precision,
-                "with_gnorm": with_gnorm,
-                "amp": has_amp, "amp_hyper": (amp_factor, amp_window),
+                "amp": has_amp,
                 # scaler-shaped neutral operands for the non-amp (and
                 # not-pending) case, built ONCE (a fresh jnp scalar per
                 # step would be an extra device_put dispatch)
@@ -458,6 +532,24 @@ class Trainer:
                 expected = expected[:-1] + (jnp.asarray(t0, jnp.int32),)
         return expected
 
+    def _remigrate_states(self, name, rule_init, params, idxs, handles,
+                          states):
+        """Cross-path state refresh shared by the one-step fused plan
+        AND the superstep: when the other compiled path advanced the
+        per-param states in ``_fused_states`` since ``states`` were
+        seeded (detected by IDENTITY — cheap pointer compares), re-seed
+        through ``_restore_fused_state`` and republish, WITHOUT
+        rebuilding or retracing the caller's executable. Returns the
+        (possibly unchanged) state list."""
+        if all(self._fused_states.get(p.name) is st
+               for p, st in zip(params, states)):
+            return states
+        states = [self._restore_fused_state(name, p, i, h.data, rule_init)
+                  for p, i, h in zip(params, idxs, handles)]
+        for p, st in zip(params, states):
+            self._fused_states[p.name] = st
+        return states
+
     def _migrate_fused_to_eager(self, param, idx, weight):
         """Reverse migration: when the eager per-param path takes over
         from the fused one (flag flipped, model turned ineligible), its
@@ -516,13 +608,7 @@ class Trainer:
         scaler = getattr(self, "_amp_loss_scaler", None)
         # staleness guards (pure Python, no device work): hyperparameter
         # shape changes or re-initialized params rebuild the plan
-        if ((o.clip_gradient is not None) != plan["has_clip"]
-                or type(o).__name__.lower() != plan["name"]
-                or _obs.ENABLED != plan["with_gnorm"]
-                or o.multi_precision != plan["multi_precision"]
-                or (scaler is not None) != plan["amp"]
-                or (scaler is not None
-                    and (scaler._factor, scaler._window) != plan["amp_hyper"])
+        if (self._fused_sig() != plan["sig"]
                 or tuple(p.grad_req for p in self._params) != plan["req_sig"]
                 or any(getattr(o, k, None) != v
                        for k, v in plan["static_hyper"].items())
@@ -533,6 +619,12 @@ class Trainer:
             plan = self._fused_setup()
             if not plan:
                 return None
+        # another path (the K-step superstep) may have advanced the
+        # shared per-param states since this plan last ran: re-migrate
+        # by IDENTITY — no rebuild, no retrace of the executable
+        plan["states"] = self._remigrate_states(
+            plan["name"], plan["rule_init"], plan["active"],
+            plan["idx"], plan["handles"], plan["states"])
         # advance update counts exactly like the eager per-param path
         for i in plan["idx"]:
             o._index_update_count[i] = o._index_update_count.get(
@@ -687,3 +779,464 @@ class Trainer:
         self._optimizer._index_update_count = blob["update_counts"]
         self._optimizer.num_update = blob["num_update"]
         self._invalidate_fused()
+
+
+def _is_execution_error(e) -> bool:
+    """True when ``e`` came from EXECUTING a compiled function rather
+    than tracing it — after execution starts, donated input buffers may
+    already be consumed, so the caller must surface the error instead
+    of falling back onto possibly-dead handles. Trace-time failures
+    (TracerError/TypeError/ValueError from a capture-unsafe forward)
+    are safe to fall back from: nothing ran, nothing was donated."""
+    name = type(e).__name__
+    return name in ("XlaRuntimeError", "JaxRuntimeError") \
+        or isinstance(e, MemoryError)
+
+
+class Superstep:
+    """K-step on-device training superstep: whole-program capture.
+
+    Compiles K full forward + backward + optimizer-update iterations of
+    the idiomatic Gluon loop into ONE ``lax.scan`` executable. The scan
+    carry is the donated weights + optimizer-state pytree (+ the AMP
+    loss-scaler state under fp16); the scanned operands are ``[K, ...]``
+    stacked batch slots staged ahead on device by
+    :class:`~mxnet_tpu.gluon.data.prefetcher.SuperstepRing`. The host
+    touches the loop once per K steps: it reads lazy telemetry gauges,
+    applies the in-graph loss-scale backoff/growth results back to the
+    scaler, and advances the lr scheduler (the K iterations of one
+    dispatch share the lr the first of them would have seen — per-step
+    scheduling inside a superstep has K-step granularity).
+
+    >>> sstep = gluon.Superstep(net, loss_fn, trainer, k=8)
+    >>> for group, n in gluon.data.SuperstepRing(loader, 8, device=ctx):
+    ...     if n == 8:
+    ...         losses = sstep.step(group[0], group[1], batch_size)
+    ...     else:                       # short tail: single-step it
+    ...         sstep.run_single(group, batch_size)
+
+    or just ``sstep.run(loader, batch_size)`` for a whole pass.
+
+    State migrates BOTH ways with the single-step paths: the scan carry
+    seeds from (and writes back to) the same per-param state store the
+    fused one-step plan and the eager per-param loop use, so mixing
+    ``trainer.step`` and supersteps never resets momentum. Ineligible
+    models (non-fusable optimizer, kvstore aggregation, sparse params,
+    capture-unsafe forward) fall back to the single-step loop with a
+    loudly logged reason — never a wrong answer.
+    """
+
+    def __init__(self, block, loss_fn, trainer, k=None):
+        self._block = block
+        self._loss_fn = loss_fn
+        self._trainer = trainer
+        self._k = max(1, int(k)) if k is not None \
+            else _fusedstep.superstep_k()
+        self._plan = None  # None = undecided, False = declined (sticky)
+
+    @property
+    def k(self):
+        return self._k
+
+    def invalidate(self):
+        """Drop the cached capture (a declined verdict too); the next
+        step re-runs eligibility and re-captures. NB: a re-capture
+        recompiles the whole K-step executable — expensive by design,
+        so mutate hyperparameters between supersteps sparingly."""
+        self._plan = None
+
+    # -- plan build ------------------------------------------------------
+    def _setup(self):
+        if self._plan is not None:
+            return self._plan
+        tr = self._trainer
+        if not tr._kv_initialized:
+            tr._init_kvstore()
+        if tr._params_to_init:
+            tr._init_params()
+
+        def no(reason):
+            _fusedstep.log_fallback("superstep", reason)
+            self._plan = False
+            return False
+
+        if tr._kvstore is not None:
+            return no("kvstore-backed gradient aggregation (use "
+                      "SPMDTrainStep.run_superstep on a mesh)")
+        o = tr._optimizer
+        rules = tr._fused_rules()  # the SAME gate the one-step plan uses
+        if isinstance(rules, str):
+            return no(rules)
+        name, hyper, rule_init, rule_update = rules
+        items = sorted(self._block.collect_params().items())
+        if not items:
+            return no("block has no parameters")
+        if any(p._data is None or p._deferred_init is not None
+               for _, p in items):
+            return False  # deferred init: decide later (not sticky)
+        if any(p._stype != "default" or p._grad_stype != "default"
+               for _, p in items):
+            return no("sparse parameters/gradients")
+        if any(len(p._data) != 1 for _, p in items):
+            return no("multi-device parameters")
+        block_names = {p.name for _, p in items}
+        if any(p.grad_req != "null" and p.name not in block_names
+               for p in tr._params):
+            return no("trainer updates params outside the captured block")
+        handles = [p.data() for _, p in items]
+        # a block param outside the trainer is carried but never updated
+        # (exactly what the plain loop does with it)
+        tr_names = {p.name for p in tr._params}
+        diff = [p.grad_req != "null" and p.name in tr_names
+                for _, p in items]
+        if not any(diff):
+            return no("no trainable parameters in the captured block")
+        diff_pos = [i for i, d in enumerate(diff) if d]
+        idx = [tr._param2idx[items[i][1].name] for i in diff_pos]
+        # optimizer states seed from wherever they currently live (a
+        # previous fused plan, eager per-param state, or fresh) — the
+        # same migration the one-step plan uses, so paths interleave
+        states = [tr._restore_fused_state(name, items[i][1], ix,
+                                          handles[i].data, rule_init)
+                  for i, ix in zip(diff_pos, idx)]
+        has_clip = o.clip_gradient is not None
+        with_gnorm = _obs.ENABLED
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        has_amp = scaler is not None
+        amp_factor = scaler._factor if has_amp else 2.0
+        amp_window = scaler._window if has_amp else 0
+
+        block, loss_fn = self._block, self._loss_fn
+        from .block import _TRACE_STATE
+
+        def run_forward(param_raws, x, y, key):
+            _TRACE_STATE.active = True
+            _random.push_trace_key(key)
+            saved = [h._data_ for h in handles]
+            saved_ver = [h._version for h in handles]
+            try:
+                for h, raw in zip(handles, param_raws):
+                    h._data_ = raw
+                    h._version += 1
+                xin, yin = NDArray(x), NDArray(y)
+                with autograd._RecordingStateScope(False, True):
+                    out = block(xin)
+                    loss = loss_fn(out, yin)
+                mutated = [h._data_ for h in handles]
+                return loss.data, mutated
+            finally:
+                for h, s, v in zip(handles, saved, saved_ver):
+                    h._data_ = s
+                    h._version = v
+                _random.pop_trace_key()
+                _TRACE_STATE.active = False
+
+        def superstep_fn(params, sts, scale, unsk, ovf, xs, ys, keys,
+                         lr, wd, rescale, clip, lr_mults, wd_mults):
+            def body(carry, slot):
+                params, sts, scale, unsk, ovf = carry
+                x, y, key = slot
+
+                def loss_of(dp):
+                    full = list(params)
+                    for pos, w in zip(diff_pos, dp):
+                        full[pos] = w
+                    loss_raw, mutated = run_forward(full, x, y, key)
+                    # grads of the SUM (what loss.backward()'s ones
+                    # cotangent yields); rescale_grad divides by batch
+                    lsum = jnp.sum(loss_raw)
+                    lmean = jnp.mean(loss_raw).astype(jnp.float32)
+                    if has_amp:
+                        # in-graph scale_loss: the fp16 loss meets the
+                        # f32 scale, promoting exactly like the eager
+                        # ``loss * NDArray(scale)``
+                        lsum = lsum.astype(jnp.float32) * scale
+                    return lsum, (lmean, mutated)
+
+                (_, (lmean, mutated)), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)([params[i] for i in diff_pos])
+                # per-iteration fp16 skip: one overflowing microbatch
+                # leaves only ITS OWN iteration's weights+state
+                # untouched — iteration i+1 of the same superstep
+                # applies — and the scale backs off/grows in-graph
+                finite = _all_finite(grads) if has_amp else None
+                it_rescale = rescale / scale if has_amp else rescale
+                new_ws, new_sts, gnorm = _apply_fused_update(
+                    [params[i] for i in diff_pos], grads, sts,
+                    rule_update, lr, wd, it_rescale, clip,
+                    lr_mults, wd_mults, has_clip, has_amp, with_gnorm,
+                    finite, scale)
+                new_params = list(mutated)  # aux (BN stats) carried here
+                for pos, w2 in zip(diff_pos, new_ws):
+                    new_params[pos] = w2
+                if has_amp:
+                    scale, unsk, ovf = _amp_scale_step(
+                        finite, scale, unsk, ovf, amp_factor, amp_window)
+                return (new_params, new_sts, scale, unsk, ovf), \
+                    (lmean, gnorm)
+
+            (params, sts, scale, unsk, ovf), (losses, gnorms) = \
+                jax.lax.scan(body, (params, sts, scale, unsk, ovf),
+                             (xs, ys, keys))
+            return params, sts, scale, unsk, ovf, losses, gnorms
+
+        fn = jax.jit(superstep_fn,
+                     donate_argnums=(0, 1) if _fusedstep.DONATE else ())
+        self._plan = {
+            "fn": fn, "handles": handles, "items": items, "diff": diff,
+            "diff_pos": diff_pos, "idx": idx, "states": states,
+            "name": name, "rule_init": rule_init,
+            "has_clip": has_clip,
+            "mults": None, "lr_mults": None, "wd_mults": None,
+            "amp": has_amp, "sig": tr._fused_sig(),
+            "req_sig": tuple(p.grad_req for _, p in items),
+            "static_hyper": {h: v for h, v in hyper.items() if h != "wd"},
+            "neutral": (jnp.asarray(1.0, jnp.float32),
+                        jnp.asarray(0, jnp.int32),
+                        jnp.asarray(0, jnp.int32)),
+            "warm": False,
+        }
+        # ownership: the scan carry is now the live optimizer state;
+        # publish it so the one-step paths (and a later superstep
+        # rebuild) migrate from here instead of resetting momentum
+        for i, st in zip(diff_pos, states):
+            tr._fused_states[items[i][1].name] = st
+        return self._plan
+
+    def _refresh_states(self, plan):
+        """Re-seed the carry's optimizer states from the shared store
+        when another path (trainer.step fused or eager) advanced them
+        between supersteps — migration WITHOUT recompiling the scan
+        (the shared ``Trainer._remigrate_states`` identity check)."""
+        tr = self._trainer
+        items, diff_pos = plan["items"], plan["diff_pos"]
+        plan["states"] = tr._remigrate_states(
+            plan["name"], plan["rule_init"],
+            [items[i][1] for i in diff_pos], plan["idx"],
+            [plan["handles"][i] for i in diff_pos], plan["states"])
+
+    def _plan_ok(self):
+        """Build-or-validate; returns the plan dict or False."""
+        plan = self._setup()
+        if not plan:
+            return False
+        tr = self._trainer
+        o = tr._optimizer
+        if (tr._fused_sig() != plan["sig"]
+                or tuple(p.grad_req for _, p in plan["items"])
+                != plan["req_sig"]
+                or any(getattr(o, h, None) != v
+                       for h, v in plan["static_hyper"].items())
+                or any(p._data is None or p.data() is not h
+                       for (_, p), h in zip(plan["items"],
+                                            plan["handles"]))):
+            self.invalidate()
+            plan = self._setup()
+            if not plan:
+                return False
+        self._refresh_states(plan)
+        return plan
+
+    # -- dispatch --------------------------------------------------------
+    def step(self, xs, ys, batch_size):
+        """Run one superstep over stacked batches: ``xs``/``ys`` carry a
+        leading ``[K]`` slot axis (``gluon.data.stack_batches``). One XLA
+        dispatch executes all K iterations; returns the K per-iteration
+        mean losses as one lazy device NDArray. Falls back to K single
+        steps (same numerics, logged reason) when the capture declines.
+        """
+        raw_x = xs.data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        raw_y = ys.data if isinstance(ys, NDArray) else jnp.asarray(ys)
+        k = int(raw_x.shape[0])
+        tr = self._trainer
+        if self._plan is None and any(
+                p._data is None
+                for _, p in self._block.collect_params().items()):
+            # resolve deferred init with one tiny predict pass on a
+            # slot-0 slice (never consumes an update). Only while no
+            # plan exists: the walk is per-dispatch host work, and a
+            # built plan's staleness guard already covers re-init.
+            with autograd.predict_mode():
+                self._block(NDArray(raw_x[0][:1]))
+        plan = self._plan_ok() if _fusedstep.ENABLED else False
+        if not plan:
+            # declined (sticky) or still deferred (re-decided next
+            # group): same numerics through the single-step loop
+            losses = self.run_single(
+                [(NDArray(raw_x[i]), NDArray(raw_y[i])) for i in range(k)],
+                batch_size)
+            return NDArray(jnp.stack([l.data for l in losses]))
+        o = tr._optimizer
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        # host bookkeeping, once per K steps: update counts advance by
+        # K; the scheduler is sampled at the FIRST iteration's count
+        # (within a superstep lr is constant — K-step granularity)
+        first_update = None
+        prev_num_update = o.num_update
+        for ix in plan["idx"]:
+            c = o._index_update_count.get(ix, o.begin_num_update) + k
+            o._index_update_count[ix] = c
+            o.num_update = max(o.num_update, c)
+            first_update = c - k + 1 if first_update is None \
+                else max(first_update, c - k + 1)
+        o.rescale_grad = tr._scale / batch_size
+        if o.lr_scheduler is not None:
+            lr_val = o.lr_scheduler(first_update)
+        else:
+            lr_val = o.learning_rate
+        mults = tuple((p.lr_mult, p.wd_mult)
+                      for i, (_, p) in enumerate(plan["items"])
+                      if plan["diff"][i])
+        if mults != plan["mults"]:
+            plan["mults"] = mults
+            plan["lr_mults"] = jnp.asarray([m[0] for m in mults],
+                                           jnp.float32)
+            plan["wd_mults"] = jnp.asarray([m[1] for m in mults],
+                                           jnp.float32)
+        lr = jnp.asarray(lr_val, jnp.float32)
+        wd = jnp.asarray(o.wd, jnp.float32)
+        rescale = jnp.asarray(o.rescale_grad, jnp.float32)
+        clip = jnp.asarray(o.clip_gradient if plan["has_clip"] else 0.0,
+                           jnp.float32)
+        if plan["amp"]:
+            if getattr(tr, "_amp_pending", False):
+                # an orphaned scale_loss backward never met its
+                # trainer.step; the superstep scales in-graph and never
+                # reads the grad buffers, so consume the stale flag —
+                # left armed, the NEXT direct trainer.step would divide
+                # fresh UNSCALED grads by the scale
+                tr._amp_pending = False
+            scale_in = scaler._scale_arr
+            unsk_in = scaler._unskipped_arr
+            ovf_in = scaler._overflow_total_arr
+        else:
+            scale_in, unsk_in, ovf_in = plan["neutral"]
+        keys = jax.random.split(_random._next_key(), k)
+        handles = plan["handles"]
+        args = ([h.data for h in handles], plan["states"],
+                scale_in, unsk_in, ovf_in, raw_x, raw_y, keys,
+                lr, wd, rescale, clip,
+                plan["lr_mults"], plan["wd_mults"])
+        t0 = time.perf_counter()
+        try:
+            out = plan["fn"](*args)
+        except Exception as e:
+            # no update was applied: roll back the count advance so the
+            # scheduler/update bookkeeping stays true to what actually
+            # ran (num_update included — the recovery path's real steps
+            # must not sample the schedule K steps ahead)
+            for ix in plan["idx"]:
+                o._index_update_count[ix] -= k
+            o.num_update = prev_num_update
+            if plan["warm"] or _is_execution_error(e):
+                # an EXECUTION failure (OOM, preemption, dead relay —
+                # warm or first run alike): donation may have consumed
+                # the live buffers, so surface it rather than silently
+                # single-stepping on possibly-dead handles
+                raise
+            # cold TRACE failure = capture-unsafe forward: fall back
+            # loudly (nothing was donated/mutated if tracing raised)
+            reason = f"capture failed: {type(e).__name__}: {e}"
+            self._plan = False
+            _fusedstep.log_fallback("superstep", reason[:200])
+            losses = self.run_single(
+                [(NDArray(raw_x[i]), NDArray(raw_y[i]))
+                 for i in range(k)], batch_size)
+            return NDArray(jnp.stack([l.data for l in losses]))
+        plan["warm"] = True
+        new_params, new_sts, new_scale, new_unsk, new_ovf, losses, \
+            gnorms = out
+        t1 = time.perf_counter()
+        for h, w in zip(handles, new_params):
+            h._set_data(w)
+        plan["states"] = new_sts
+        for i, st in zip(plan["diff_pos"], new_sts):
+            tr._fused_states[plan["items"][i][1].name] = st
+        # no plan invalidation needed: the one-step fused path detects
+        # the _fused_states identity change and re-migrates its state
+        # copies without rebuilding/retracing its executable
+        if plan["amp"]:
+            scaler._scale_arr = new_scale
+            scaler._unskipped_arr = new_unsk
+            scaler._overflow_total_arr = new_ovf
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("superstep")
+            _obs.record_superstep(k, t0, t1, gnorms[-1])
+            if plan["amp"]:
+                _obs.record_amp_lazy(scaler._scale_arr, new_ovf)
+        return NDArray(losses)
+
+    # -- fallback / tail -------------------------------------------------
+    def run_single(self, batches, batch_size):
+        """Run ``batches`` (``(x, y)`` pairs) through the normal
+        single-step loop — the tail of an epoch whose last group came up
+        short, or the fallback for declined captures. Same numerics as
+        user-written record/backward/step. Returns per-batch mean-loss
+        NDArrays."""
+        tr = self._trainer
+        scaler = getattr(tr, "_amp_loss_scaler", None)
+        losses = []
+        for x, y in batches:
+            with autograd.record():
+                loss = self._loss_fn(self._block(x), y)
+                if scaler is not None:
+                    from .. import amp as _amp
+
+                    with _amp.scale_loss(loss, tr) as scaled:
+                        scaled.backward()
+            if scaler is None:
+                loss.backward()
+            tr.step(batch_size)
+            losses.append(NDArray(jnp.mean(loss.data)))
+        return losses
+
+    @staticmethod
+    def _split_xy(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        if batch.__class__.__name__ == "DataBatch" \
+                and hasattr(batch, "data"):
+            return batch.data[0], batch.label[0]
+        raise MXNetError(
+            "Superstep.run expects (x, y) batches or DataBatch; use "
+            "step(xs, ys, batch_size) for custom structures")
+
+    def run(self, source, batch_size, device=None, mesh=None):
+        """One pass over ``source`` (DataLoader / DataIter / iterable /
+        an existing ``SuperstepRing``): full K-groups run as one
+        dispatch each, a short tail single-steps. Returns the per-step
+        mean losses as floats (one device sync, at the end)."""
+        from .data.prefetcher import SuperstepRing
+
+        ring = source if isinstance(source, SuperstepRing) \
+            else SuperstepRing(source, self._k, device=device, mesh=mesh)
+        out = []
+        try:
+            for group, n in ring:
+                # n == RING.k <=> a stacked full group (the ring only
+                # yields raw batch LISTS for short tails, which always
+                # have n < ring.k) — the ring's own k is the authority:
+                # comparing against self._k would mistake a tail of
+                # exactly self._k batches for a stacked block when the
+                # caller passed a ring with a different k. The stacked
+                # batch itself may well BE a list (the DataLoader
+                # default batchify yields [x, y]).
+                if n == ring.k:
+                    x, y = self._split_xy(group)
+                    out.append(self.step(x, y, batch_size))
+                else:
+                    out.extend(self.run_single(
+                        [self._split_xy(b) for b in group], batch_size))
+        finally:
+            ring.close()
+        if not out:
+            return []
+        import numpy as _np
+
+        # ONE device->host transfer: concatenate the lazy per-group
+        # loss arrays on device first (syncing each of the ~steps/K
+        # results serially would re-add the per-dispatch RTT the
+        # superstep amortizes away)
+        joined = jnp.concatenate(
+            [jnp.atleast_1d(l.data).astype(jnp.float32) for l in out])
+        return _np.asarray(joined).tolist()

@@ -134,7 +134,8 @@ KV_BARRIER_TOTAL = _REGISTRY.counter(
 XLA_DISPATCH_TOTAL = _REGISTRY.counter(
     "mxtpu_xla_dispatch_total",
     "compiled-executable invocations, by site (op / cachedop_fwd / "
-    "cachedop_bwd / kv_grouped / kv_bucket / trainer_fused)")
+    "cachedop_bwd / kv_grouped / kv_bucket / trainer_fused / "
+    "superstep / superstep_stage)")
 
 FUSED_FALLBACK_TOTAL = _REGISTRY.counter(
     "mxtpu_fused_fallback_total",
@@ -195,6 +196,19 @@ SHAPE_WOBBLE_TOTAL = _REGISTRY.counter(
     "mxtpu_shape_wobble_total",
     "CachedGraph shape-signature count exceeded MXTPU_RETRACE_BUDGET, "
     "by block — pad/bucket the inputs (docs/performance.md)")
+
+SUPERSTEP_TOTAL = _REGISTRY.counter(
+    "mxtpu_superstep_total",
+    "K-step on-device superstep dispatches, by k")
+SUPERSTEP_ITERATIONS_TOTAL = _REGISTRY.counter(
+    "mxtpu_superstep_iterations_total",
+    "training iterations executed inside superstep dispatches (the "
+    "denominator for dispatches-per-step amortization)")
+SUPERSTEP_STEP_SECONDS = _REGISTRY.histogram(
+    "mxtpu_superstep_amortized_step_seconds",
+    "superstep wall time divided by its K — the amortized per-step "
+    "time the host observes (gauges update once per superstep, so "
+    "per-step series have K-step cadence; docs/observability.md)")
 
 AMP_LOSS_SCALE = _REGISTRY.gauge(
     "mxtpu_amp_loss_scale",
@@ -276,6 +290,25 @@ def record_trainer_step(t0: float, t1: float, grad_norm=None):
         # keeps the latest lazy value; trace events just omit it)
         args["grad_norm"] = grad_norm
     _TRACER.record("trainer.step", cat="trainer", ts=t0, dur=dt, args=args)
+
+
+def record_superstep(k: int, t0: float, t1: float, grad_norm=None):
+    """One K-step superstep dispatch: counts K iterations, observes the
+    AMORTIZED per-step time, and advances the tracer step by K (host
+    telemetry runs once per superstep — K-step cadence by design)."""
+    dt = t1 - t0
+    SUPERSTEP_TOTAL.inc(1, k=str(k))
+    SUPERSTEP_ITERATIONS_TOTAL.inc(k)
+    SUPERSTEP_STEP_SECONDS.observe(dt / max(k, 1))
+    if grad_norm is not None:
+        # lazy device scalar from the scan's last iteration — syncs only
+        # at gauge-read time, never per superstep
+        TRAINER_GRAD_NORM.set_lazy(grad_norm)
+    step = None
+    for _ in range(k):
+        step = _TRACER.mark_step()
+    _TRACER.record("trainer.superstep", cat="trainer", ts=t0, dur=dt,
+                   args={"k": int(k), "step": step})
 
 
 def record_amp_scale(scale, overflow_total, overflow: bool):
@@ -376,6 +409,14 @@ def summary() -> str:
     cc_h, cc_m = COMPILE_CACHE_HITS.total(), COMPILE_CACHE_MISSES.total()
     if cc_h or cc_m:
         lines.append(f"  compile cache: {int(cc_h)} hits, {int(cc_m)} misses")
+    ss = SUPERSTEP_TOTAL.total()
+    if ss:
+        iters = SUPERSTEP_ITERATIONS_TOTAL.total()
+        mean_ms = (SUPERSTEP_STEP_SECONDS.sum() / max(ss, 1)) * 1e3
+        lines.append(
+            f"  superstep: {int(ss)} dispatches covering {int(iters)} "
+            f"steps ({iters / ss:.1f} steps/dispatch, "
+            f"{mean_ms:.2f} ms/step amortized)")
     steps = TRAINER_STEP_TOTAL.total()
     if steps:
         mean_ms = TRAINER_STEP_SECONDS.sum() / max(steps, 1) * 1e3

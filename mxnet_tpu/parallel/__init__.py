@@ -9,6 +9,7 @@ annotations on the same step (P9/P13 in SURVEY.md §2.5).
 
 from .mesh import make_mesh, current_mesh, data_parallel_mesh  # noqa: F401
 from .spmd import (SPMDTrainStep, shard_batch, replicate,  # noqa: F401
+                   bucketed_psum,  # noqa: F401
                    spmd_save_states, spmd_load_states)  # noqa: F401
 from .ring_attention import ring_attention, shard_sequence  # noqa: F401
 from .pipeline import (PipelineTrainStep, pipeline_apply,  # noqa: F401,E402

@@ -210,6 +210,51 @@ def mp_rule(rule_init, rule_update):
     return init, update
 
 
+def bucketed_psum(grads, axis_name, bucket_bytes=None):
+    """Scan-compatible bucketed gradient allreduce: one ``lax.psum`` per
+    ~``bucket_bytes`` dtype-homogeneous flat bucket instead of one per
+    gradient tensor — the in-graph analog of the kvstore's bucketed
+    pushpull (PR 3), usable inside ``shard_map``/``lax.scan`` bodies
+    (pure, no host round trip, stable avals across iterations). Returns
+    the reduced gradients in the original order/shapes/dtypes.
+
+    This is what a K-step superstep body calls per iteration on a
+    multi-device mesh: K iterations x one-psum-per-bucket, all inside a
+    single dispatched executable."""
+    from .. import fusedstep as _fusedstep
+
+    target = int(bucket_bytes if bucket_bytes is not None
+                 else _fusedstep.bucket_bytes())
+    flat = [g.reshape(-1) for g in grads]
+    # greedy dtype-homogeneous fill, preserving order within a dtype
+    buckets = []  # [idx list, payload bytes], one per bucket
+    open_by_dtype = {}
+    for i, f in enumerate(flat):
+        dt = f.dtype
+        nbytes = f.size * f.dtype.itemsize
+        cur = open_by_dtype.get(dt)
+        if cur is None or (cur[1] + nbytes > target and cur[0]):
+            cur = [[], 0]
+            open_by_dtype[dt] = cur
+            buckets.append(cur)
+        cur[0].append(i)
+        cur[1] += nbytes
+    out = [None] * len(grads)
+    for idxs, _ in buckets:
+        if len(idxs) == 1:
+            i = idxs[0]
+            out[i] = jax.lax.psum(grads[i], axis_name)
+            continue
+        packed = jnp.concatenate([flat[i] for i in idxs])
+        red = jax.lax.psum(packed, axis_name)
+        off = 0
+        for i in idxs:
+            n = flat[i].size
+            out[i] = red[off:off + n].reshape(grads[i].shape)
+            off += n
+    return out
+
+
 class SPMDTrainStep:
     """One-executable train step for a Gluon block over a mesh.
 
@@ -483,6 +528,72 @@ class SPMDTrainStep:
         self._state = (new_params, new_states)
         self._last_loss = loss
         return loss
+
+    def run_superstep(self, xs, ys, lr=0.01):
+        """K DISTINCT batches in one dispatch: ``lax.scan`` of the
+        compiled step over stacked ``[K, ...]`` operands. ``run_steps``
+        re-consumes ONE batch (a bulked micro-benchmark); this is the
+        training superstep — each scan iteration consumes its own batch
+        slot, so a real input pipeline (``gluon.data.SuperstepRing``)
+        feeds it with the host touching the loop once per K steps.
+        Per-iteration RNG keys fold from one base key. Returns the
+        per-iteration losses as a length-K device array (lazy)."""
+        raw_x = xs.data if isinstance(xs, NDArray) else jnp.asarray(xs)
+        raw_y = ys.data if isinstance(ys, NDArray) else jnp.asarray(ys)
+        if self._state is None:
+            # resolve deferred init + build state WITHOUT consuming an
+            # update (a priming step would apply slot 0 twice): same
+            # host-row predict probe as __call__
+            import numpy as onp
+
+            if isinstance(raw_x, jax.Array) and raw_x.addressable_shards:
+                host = onp.asarray(raw_x.addressable_shards[0].data)
+            else:
+                host = onp.asarray(raw_x)
+            xin = NDArray(jnp.asarray(host[0][0:1] if host[0].ndim and
+                                      host[0].shape[0] > 1 else host[0]))
+            with autograd.predict_mode():
+                self.block(xin)
+            self.init_state()
+        if self._compiled is None:
+            self._compiled = self._build(None, None)
+        if self.mesh is not None:
+            # slot axis 0 stays unsharded; the per-iteration batch axis
+            # (dim 1) shards over the mesh exactly like a single step's
+            raw_x = _put_global(raw_x, NamedSharding(
+                self.mesh, P(None, self.batch_axis,
+                             *([None] * (raw_x.ndim - 2)))))
+            raw_y = _put_global(raw_y, NamedSharding(
+                self.mesh, P(None, self.batch_axis,
+                             *([None] * (raw_y.ndim - 2)))))
+        lr_arr = jnp.asarray(lr, raw_x.dtype
+                             if raw_x.dtype in (jnp.float32, jnp.bfloat16)
+                             else jnp.float32)
+        base_key = _random._next_key()
+        inner = self._compiled
+
+        if getattr(self, "_run_super", None) is None:
+            def many(params, opt_states, xxs, yys, lr_a, keys):
+                def body(carry, slot):
+                    p, s = carry
+                    xx, yy, key = slot
+                    p2, s2, loss = inner(p, s, xx, yy, lr_a, key)
+                    return (p2, s2), loss
+
+                (p, s), losses = jax.lax.scan(
+                    body, (params, opt_states), (xxs, yys, keys))
+                return p, s, losses
+
+            donate = (0, 1) if self._donate else ()
+            self._run_super = jax.jit(many, donate_argnums=donate)
+        k = int(raw_x.shape[0])
+        keys = jax.random.split(base_key, k)
+        params, opt_states = self._state
+        new_params, new_states, losses = self._run_super(
+            params, opt_states, raw_x, raw_y, lr_arr, keys)
+        self._state = (new_params, new_states)
+        self._last_loss = losses[-1]
+        return losses
 
     def cost_analysis(self):
         """XLA's cost analysis for the compiled step (``{"flops": ...}``),

@@ -149,16 +149,24 @@ def check_numeric_gradient(fn, inputs, grads=None, eps=1e-4, rtol=1e-2,
         num = _np.zeros_like(base)
         flat = base.reshape(-1)
         num_flat = num.reshape(-1)
+        # the reduction runs on HOST in float64: a device fp32 .sum()
+        # adds ~ulp(sum) of rounding noise, and divided by 2*eps that is
+        # ~ulp(sum)/2e-4 — observed 2.4e-3 absolute gradient error on
+        # gelu, enough to fail a 1e-3 atol. With the f64 host sum the
+        # unperturbed elements' fp32 errors cancel exactly in fp - fm.
+        def f64_sum():
+            with autograd.pause():
+                return float(fn(*arrays).asnumpy()
+                             .astype(_np.float64).sum())
+
         for i in range(flat.size):
             orig = flat[i]
             flat[i] = orig + eps
             a._set_data(base.reshape(base.shape).astype(a.dtype))
-            with autograd.pause():
-                fp = float(fn(*arrays).sum().asscalar())
+            fp = f64_sum()
             flat[i] = orig - eps
             a._set_data(base.reshape(base.shape).astype(a.dtype))
-            with autograd.pause():
-                fm = float(fn(*arrays).sum().asscalar())
+            fm = f64_sum()
             flat[i] = orig
             a._set_data(base.reshape(base.shape).astype(a.dtype))
             num_flat[i] = (fp - fm) / (2 * eps)

@@ -20,7 +20,7 @@ bench.main()
 """
 
 
-def test_bench_emits_driver_contract(tmp_path):
+def _smoke_env(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("BENCH_")}  # ambient knobs must not leak in
     env["XLA_FLAGS"] = " ".join(
@@ -30,7 +30,36 @@ def test_bench_emits_driver_contract(tmp_path):
     env["BENCH_PR3_OUT"] = str(tmp_path / "BENCH_pr3.json")
     env["BENCH_PR4_OUT"] = str(tmp_path / "BENCH_pr4.json")
     env["BENCH_PR5_OUT"] = str(tmp_path / "BENCH_pr5.json")
+    env["BENCH_PR6_OUT"] = str(tmp_path / "BENCH_pr6.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
+    return env
+
+
+def _warm_cache_rec(recs):
+    warm = [r for r in recs
+            if r["metric"].startswith("compile_cache_warm")]
+    return warm[0] if warm else None
+
+
+def _rerun_cache_probe(env):
+    """A warm-cache miss count > 0 is almost always host pressure (slow
+    cache writes / probe timeouts), not a regression — re-run JUST the
+    input_pipeline scenario in a clean subprocess once before failing."""
+    env2 = dict(env)
+    env2["BENCH_ONLY"] = "input_pipeline"
+    # the retry must not clobber the full run's records under assert
+    env2["BENCH_PR4_OUT"] = env["BENCH_PR4_OUT"] + ".retry"
+    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
+    res = subprocess.run(
+        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
+        env=env2, capture_output=True, text=True, timeout=600)
+    recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    return _warm_cache_rec(recs), res
+
+
+def test_bench_emits_driver_contract(tmp_path):
+    env = _smoke_env(tmp_path)
     res = subprocess.run(
         [sys.executable, "-c", _RUNNER.format(root=ROOT)],
         env=env, capture_output=True, text=True, timeout=600)
@@ -49,12 +78,23 @@ def test_bench_emits_driver_contract(tmp_path):
     assert any("flash_attention" in n for n in names)
     assert any("allreduce" in n for n in names)
     assert any(n.startswith("input_pipeline_prefetch") for n in names)
-    # warm persistent-compile-cache start must skip recompilation
-    # (probe failures land on bench stderr — surface them on assert)
-    warm = [r for r in recs
-            if r["metric"].startswith("compile_cache_warm")]
-    assert warm and warm[0]["cache_misses"] == 0, \
-        (warm, res.stderr[-2000:])
+    # warm persistent-compile-cache start must skip recompilation; a
+    # nonzero miss count gets ONE clean-subprocess retry first (host
+    # pressure must not masquerade as a cache regression)
+    warm = _warm_cache_rec(recs)
+    if not (warm and warm["cache_misses"] == 0):
+        warm, res2 = _rerun_cache_probe(env)
+        assert warm and warm["cache_misses"] == 0, \
+            (warm, res.stderr[-1000:], res2.stderr[-1000:])
+    # superstep scenario (PR6): K=1 vs K=8 legs, dispatches/step
+    # amortized >= 4x, and BENCH_pr6.json lands
+    ss = [r for r in recs if "superstep_k8" in r["metric"]]
+    assert ss, names
+    assert ss[0]["dispatch_reduction"] >= 4, ss
+    assert any("superstep_k1" in n for n in names)
+    pr6 = json.load(open(tmp_path / "BENCH_pr6.json"))
+    assert pr6["scenario"] == "superstep" \
+        and pr6["dispatch_reduction"] >= 4, pr6
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs
@@ -68,4 +108,5 @@ def test_bench_emits_driver_contract(tmp_path):
     # listed as completed, failures (none here) keyed by scenario
     status = json.load(open(tmp_path / "BENCH_STATUS.json"))
     assert status["rc"] == 0, status
-    assert "amp" in status["completed"] and not status["failed"], status
+    assert "amp" in status["completed"] and "superstep" in \
+        status["completed"] and not status["failed"], status

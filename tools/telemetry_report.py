@@ -130,6 +130,31 @@ def render_amp(events):
         f"overflow total: {arg(last, 'overflow_total')}"])
 
 
+def render_superstep(events):
+    """Dispatches-per-step amortization from ``trainer.superstep``
+    events (one event per K-step dispatch, ``args.k`` = its K). Same
+    crash-proofing contract as the AMP section: absent series -> empty
+    string, malformed args count as K=1."""
+    evs = [ev for ev in events if ev.get("name") == "trainer.superstep"]
+    if not evs:
+        return ""
+
+    def k_of(ev):
+        args = ev.get("args")
+        try:
+            return max(1, int(args.get("k", 1))) if isinstance(args, dict) \
+                else 1
+        except (TypeError, ValueError):
+            return 1
+
+    steps = sum(k_of(ev) for ev in evs)
+    return "\n".join([
+        "", "Superstep amortization:",
+        f"  {len(evs)} dispatches covering {steps} training steps -> "
+        f"{len(evs) / steps:.3f} dispatches/step "
+        f"(mean K = {steps / len(evs):.1f})"])
+
+
 def render_steps(events):
     """Per-step timeline of trainer.step spans, when present."""
     steps = [ev for ev in events if ev.get("name") == "trainer.step"]
@@ -167,6 +192,9 @@ def main(argv=None):
     amp = render_amp(events)
     if amp:
         print(amp)
+    sstep = render_superstep(events)
+    if sstep:
+        print(sstep)
     if args.steps:
         out = render_steps(events)
         if out:
