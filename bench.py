@@ -48,6 +48,15 @@ def _peak_tflops():
     return None
 
 
+def _mfu_null_reason():
+    """Why this backend cannot produce an MFU number (stamped into the
+    row so a null is always explained — ROADMAP item-3 contract)."""
+    from mxnet_tpu.observability import introspect
+
+    _, _, reason = introspect.device_peaks()
+    return reason or "no step FLOP accounting for this metric"
+
+
 _EMIT_BUFFER = None  # non-None => buffer records instead of printing
 
 
@@ -56,6 +65,21 @@ def _emit(metric, value, unit, vs_baseline=None, **extra):
            "vs_baseline": round(vs_baseline, 4) if vs_baseline else 1.0}
     rec.update({k: (round(v, 3) if isinstance(v, float) else v)
                 for k, v in extra.items()})
+    if rec.get("mfu_reason") is None:
+        rec.pop("mfu_reason", None)  # re-added below iff mfu is null
+    # EVERY row carries flops_per_step + mfu — an explicit null always
+    # pairs with a reason (backends without cost analysis / peak table,
+    # or metrics with no per-step FLOP meaning), so the driver can tell
+    # "unmeasurable here" from "forgot to measure"
+    if rec.get("flops_per_step") is None:
+        rec["flops_per_step"] = None
+        rec.setdefault(
+            "mfu_reason",
+            extra.get("mfu_reason")
+            or "no per-step FLOP accounting for this metric")
+    if rec.get("mfu") is None:
+        rec["mfu"] = None
+        rec.setdefault("mfu_reason", _mfu_null_reason())
     line = json.dumps(rec)
     if _EMIT_BUFFER is not None:
         _EMIT_BUFFER.append(line)
@@ -120,7 +144,7 @@ def bench_resnet(backend):
     peak = _peak_tflops()
     _emit(f"resnet50_v1_train_{dtype}_bs{batch}_{backend}", img_s,
           "images/sec", img_s / BASELINE_RESNET_IMG_S,
-          step_ms=step_ms, tflops=tflops,
+          step_ms=step_ms, tflops=tflops, flops_per_step=flops,
           mfu=(tflops / peak) if peak else None, steps=steps)
     if backend != "cpu" and os.environ.get("BENCH_PIPELINE") == "1":
         _bench_resnet_pipeline_fed(step, batch, size, dtype, img_s)
@@ -277,7 +301,7 @@ def bench_bert(backend):
     peak = _peak_tflops()
     _emit(f"bert_base_train_{dtype}_bs{batch}_seq{seqlen}_{backend}",
           samples_s, "samples/sec", samples_s / BASELINE_BERT_SAMPLES_S,
-          step_ms=step_ms, tflops=tflops,
+          step_ms=step_ms, tflops=tflops, flops_per_step=flops_step,
           mfu=(tflops / peak) if peak else None, steps=steps)
 
 
@@ -313,7 +337,7 @@ def bench_flash_attention(backend):
     tflops = flops_step / per_step / 1e12
     peak = _peak_tflops()
     _emit(f"flash_attention_fwdbwd_T{T}_D{D}_{backend}", tflops, "TFLOP/s",
-          None, step_ms=per_step * 1e3,
+          None, step_ms=per_step * 1e3, flops_per_step=flops_step,
           mfu=(tflops / peak) if peak else None,
           pallas=bool(fa._HAS_PALLAS and fa._use_pallas(D)))
 
@@ -337,9 +361,11 @@ def bench_flash_attention(backend):
         per_w = chain_time_per_iter(fstep_w, ql, 20, 120, reps=4)
         # band area ~= T*W (minus the triangular ramp-in, negligible)
         flops_w = 2 * 2 * 1 * H * Tl * W * D
+        tfl_w = flops_w / per_w / 1e12
         _emit(f"flash_attention_sldwin_fwd_T{Tl}_W{W}_D{D}_{backend}",
-              flops_w / per_w / 1e12, "TFLOP/s", None,
-              step_ms=per_w * 1e3, window=W)
+              tfl_w, "TFLOP/s", None,
+              step_ms=per_w * 1e3, window=W, flops_per_step=flops_w,
+              mfu=(tfl_w / peak) if peak else None)
 
 
 def bench_train_step(backend):
@@ -369,8 +395,13 @@ def bench_train_step(backend):
     Y = mx.nd.array(np.random.RandomState(1).randint(0, 10, (batch,))
                     .astype(np.float32))
 
+    from mxnet_tpu import observability as obs
+
     def run(fused):
         prev = fusedstep.set_enabled(fused)
+        # XLA cost analysis on the fused leg's executables (fwd/bwd/
+        # update): where the row's flops_per_step/mfu stamp comes from
+        prev_intro = obs.introspect.set_enabled(True) if fused else None
         try:
             mx.random.seed(0)
             net = nn.HybridSequential()
@@ -401,15 +432,27 @@ def bench_train_step(backend):
             return steps / (time.perf_counter() - t0)
         finally:
             fusedstep.set_enabled(prev)
+            if prev_intro is not None:
+                obs.introspect.set_enabled(prev_intro)
 
+    obs.introspect.reset()  # this scenario's sites only
     eager_sps = run(False)
     fused_sps = run(True)
+    fps, fps_reason = obs.introspect.flops_per_step()
+    peak = _peak_tflops()
+    tflops = fps * fused_sps / 1e12 if fps else None
+    mfu = (tflops / peak) if tflops and peak else None
     tag = f"mlp{n_layers}x{width}_bs{batch}_{backend}"
     _emit(f"train_step_eager_{tag}", eager_sps, "steps/sec", None,
-          step_ms=1e3 / eager_sps, steps=steps)
+          step_ms=1e3 / eager_sps, steps=steps,
+          flops_per_step=fps, mfu=None,
+          mfu_reason=fps_reason or _mfu_null_reason())
     _emit(f"train_step_fused_{tag}", fused_sps, "steps/sec", None,
           step_ms=1e3 / fused_sps, steps=steps,
-          speedup_vs_eager=round(fused_sps / eager_sps, 3))
+          speedup_vs_eager=round(fused_sps / eager_sps, 3),
+          flops_per_step=fps, tflops=tflops, mfu=mfu,
+          mfu_reason=None if mfu is not None
+          else (fps_reason or _mfu_null_reason()))
     out_path = os.environ.get(
         "BENCH_PR3_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -420,7 +463,10 @@ def bench_train_step(backend):
                               "batch": batch, "steps": steps},
                    "eager_steps_per_sec": round(eager_sps, 2),
                    "fused_steps_per_sec": round(fused_sps, 2),
-                   "fused_speedup": round(fused_sps / eager_sps, 3)}, f,
+                   "fused_speedup": round(fused_sps / eager_sps, 3),
+                   "flops_per_step": fps, "mfu": mfu,
+                   "mfu_reason": None if mfu is not None
+                   else (fps_reason or _mfu_null_reason())}, f,
                   indent=2)
         f.write("\n")
 
@@ -473,6 +519,8 @@ def bench_superstep(backend):
         return net, tr
 
     prev_obs = obs.set_enabled(True)
+    prev_intro = obs.introspect.set_enabled(True)
+    obs.introspect.reset()  # this scenario's sites only
     try:
         def dispatches():
             return obs.XLA_DISPATCH_TOTAL.total()
@@ -513,17 +561,34 @@ def bench_superstep(backend):
         d_kk = (dispatches() - c0) / steps
     finally:
         obs.set_enabled(prev_obs)
+        obs.introspect.set_enabled(prev_intro)
 
     reduction = d_k1 / max(d_kk, 1e-9)
+    # XLA cost analysis: the k1 leg's fwd/bwd/update trio, and the K-step
+    # scan executable (its figure covers K iterations -> divide by K)
+    fps_k1, r_k1 = obs.introspect.flops_per_step()
+    ss_cost = obs.introspect.site_cost("superstep") or {}
+    fps_ss = (ss_cost.get("flops") / k) if ss_cost.get("flops") else None
+    r_ss = None if fps_ss else ss_cost.get(
+        "error", "superstep executable not registered")
+    peak = _peak_tflops()
+
+    def _mfu(fps, sps):
+        return (fps * sps / 1e12 / peak) if fps and peak else None
+
     tag = f"mlp{n_layers}x{width}_bs{batch}_{backend}"
     _emit(f"train_step_superstep_k1_{tag}", k1_sps, "steps/sec", None,
           step_ms=1e3 / k1_sps, steps=steps,
-          dispatches_per_step=round(d_k1, 3))
+          dispatches_per_step=round(d_k1, 3),
+          flops_per_step=fps_k1, mfu=_mfu(fps_k1, k1_sps),
+          mfu_reason=r_k1)
     _emit(f"train_step_superstep_k{k}_{tag}", ss_sps, "steps/sec", None,
           step_ms=1e3 / ss_sps, steps=steps,
           speedup_vs_k1=round(ss_sps / k1_sps, 3),
           dispatches_per_step=round(d_kk, 3),
-          dispatch_reduction=round(reduction, 1))
+          dispatch_reduction=round(reduction, 1),
+          flops_per_step=fps_ss, mfu=_mfu(fps_ss, ss_sps),
+          mfu_reason=r_ss)
     out_path = os.environ.get(
         "BENCH_PR6_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -537,7 +602,11 @@ def bench_superstep(backend):
                    "superstep_speedup_vs_k1": round(ss_sps / k1_sps, 3),
                    "dispatches_per_step_k1": round(d_k1, 3),
                    "dispatches_per_step_superstep": round(d_kk, 3),
-                   "dispatch_reduction": round(reduction, 1)}, f,
+                   "dispatch_reduction": round(reduction, 1),
+                   "flops_per_step": fps_ss,
+                   "mfu": _mfu(fps_ss, ss_sps),
+                   "mfu_reason": r_ss or (None if peak else
+                                          _mfu_null_reason())}, f,
                   indent=2)
         f.write("\n")
 
@@ -674,7 +743,11 @@ def bench_amp(backend):
                    "bf16_steps_per_sec": round(bf16_sps, 2),
                    "bf16_speedup_vs_fp32": round(speedup, 3),
                    "fp16_overflow_recovered": recovered,
-                   "fp16_final_scale": final_scale}, f, indent=2)
+                   "fp16_final_scale": final_scale,
+                   "flops_per_step": None, "mfu": None,
+                   "mfu_reason": "amp scenario compares dtype legs; "
+                                 "see the train_step row for the "
+                                 "cost-analysis FLOP stamp"}, f, indent=2)
         f.write("\n")
 
 
@@ -841,7 +914,11 @@ def bench_input_pipeline(backend):
                    "sync_batches_per_sec": round(sync_bps, 2),
                    "prefetch_batches_per_sec": round(pre_bps, 2),
                    "prefetch_speedup": round(speedup, 3),
-                   "compile_cache": cache}, f, indent=2)
+                   "compile_cache": cache,
+                   "flops_per_step": None, "mfu": None,
+                   "mfu_reason": "input-pipeline scenario measures "
+                                 "feeding overlap, not device FLOPs"},
+                  f, indent=2)
         f.write("\n")
 
 
@@ -999,6 +1076,20 @@ def main():
                 _EMIT_BUFFER = None
     _write_status({"rc": 0 if not failed else 1, "backend": backend,
                    "completed": completed, "failed": failed})
+    # telemetry dump for post-hoc triage: the trace ring (trainer spans,
+    # superstep amortization events, introspect.cost records) lands as
+    # JSONL; `tools/telemetry_report.py BENCH_telemetry.jsonl` renders
+    # the aggregate table + the per-site roofline section from it
+    try:
+        from mxnet_tpu import observability as _obs_dump
+
+        if len(_obs_dump.tracer()):
+            _obs_dump.dump_jsonl(os.environ.get(
+                "BENCH_TELEMETRY_OUT",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_telemetry.jsonl")))
+    except Exception as e:  # a failed dump must not fail the round
+        print(f"# telemetry dump failed: {e}", file=sys.stderr, flush=True)
     # DELIBERATE: partial failures still exit 0 — the driver records the
     # stdout tail metric, and a nonzero process rc could discard the
     # scenarios that DID complete (the very failure mode this hardening

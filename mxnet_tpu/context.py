@@ -37,14 +37,18 @@ _CACHE_WIRED = False
 
 
 def _wire_compile_cache():
-    """One-shot MXTPU_COMPILE_CACHE hookup, deferred to the first
-    Context so plain imports never touch jax config (and the flag keeps
-    Context.__init__ to one boolean check afterwards)."""
+    """One-shot env hookups deferred to the first Context so plain
+    imports never touch jax config (and the flag keeps
+    Context.__init__ to one boolean check afterwards):
+    MXTPU_COMPILE_CACHE, and the MXTPU_METRICS_PORT scrape endpoint."""
     global _CACHE_WIRED
     _CACHE_WIRED = True
     from . import runtime
 
     runtime.setup_compile_cache()
+    from .observability import serve as _serve
+
+    _serve.maybe_serve()
 
 
 class Context:

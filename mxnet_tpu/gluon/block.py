@@ -701,9 +701,13 @@ class _CachedGraph:
                 dp = [call_handles[i].data for i in diff_param_pos]
                 ndp = [call_handles[i].data for i in range(len(call_handles))
                        if not diff_mask[i]]
-                out_raws, mut_raws = fwd_compiled(
-                    dp, ndp, [a.data for a in call_arrays], key
-                )
+                fwd_args = (dp, ndp, [a.data for a in call_arrays], key)
+                if _obs.introspect.ENABLED:
+                    site = f"cachedop_fwd[{block_name(block)}]"
+                    if not _obs.introspect.registered(site):
+                        _obs.introspect.register_jit(
+                            site, fwd_compiled, fwd_args)
+                out_raws, mut_raws = fwd_compiled(*fwd_args)
                 if _obs.ENABLED:
                     _obs.record_xla_dispatch("cachedop_fwd")
                 for i, raw in zip(mutated_idx, mut_raws):
@@ -852,8 +856,18 @@ class _CachedGraph:
             ndp = [call_handles[i].data for i in range(len(call_handles))
                    if not diff_mask[i]]
             input_raws = [a.data for a in call_arrays]
-            out_raws, mut_raws, vjp_fn = fwd_vjp_compiled(
-                dp, ndp, input_raws, key)
+            if _obs.introspect.ENABLED:
+                site = f"cachedop_fwd[{block_name(block)}]"
+                if not _obs.introspect.registered(site):
+                    _obs.introspect.register_jit(
+                        site, fwd_vjp_compiled, (dp, ndp, input_raws, key))
+            if _obs.flight.INSTALLED:
+                with _obs.flight.dispatch("cachedop_fwd"):
+                    out_raws, mut_raws, vjp_fn = fwd_vjp_compiled(
+                        dp, ndp, input_raws, key)
+            else:
+                out_raws, mut_raws, vjp_fn = fwd_vjp_compiled(
+                    dp, ndp, input_raws, key)
             if _obs.ENABLED:
                 _obs.record_xla_dispatch("cachedop_fwd")
             for i, raw in zip(mutated_idx, mut_raws):
@@ -877,7 +891,19 @@ class _CachedGraph:
                     if _obs.ENABLED:
                         _obs.record_xla_dispatch("cachedop_fwd")
                 res_box[0] = vf if not _fusedstep.DONATE else None
-                grads = get_bwd(mut_avals)(vf, cts)
+                bwd = get_bwd(mut_avals)
+                if _obs.introspect.ENABLED:
+                    site = f"cachedop_bwd[{block_name(block)}]"
+                    if not _obs.introspect.registered(site):
+                        # aval skeleton, captured before the donating call
+                        _obs.introspect.register_jit(
+                            site, bwd, _obs.introspect.avals_of((vf, cts)),
+                            donated=_fusedstep.DONATE)
+                if _obs.flight.INSTALLED:
+                    with _obs.flight.dispatch("cachedop_bwd"):
+                        grads = bwd(vf, cts)
+                else:
+                    grads = bwd(vf, cts)
                 if _obs.ENABLED:
                     _obs.record_xla_dispatch("cachedop_bwd")
                 if inputs_tracked:

@@ -25,6 +25,7 @@ per K steps. See docs/performance.md "superstep".
 
 from __future__ import annotations
 
+import contextlib
 import time
 
 import jax
@@ -47,6 +48,17 @@ from .parameter import Parameter, ParameterDict
 # body: the two paths are parity-pinned, so the per-iteration arithmetic
 # must live in exactly one place (like _fused_rules/_fused_sig for
 # eligibility/staleness).
+
+def _dispatch_call(site, span, fn, args):
+    """Slow-path executable invocation: marks ``site`` in flight for
+    the crash flight recorder and opens a named profiler span. Call
+    sites take this route only when the recorder is installed or a
+    profiler window is armed — the normal path stays a bare call."""
+    rec = _obs.flight.dispatch(site) if _obs.flight.INSTALLED \
+        else contextlib.nullcontext()
+    with rec, _obs.introspect.annotate(span):
+        return fn(*args)
+
 
 def _all_finite(gs):
     """ONE fused all-finite reduction over a gradient list (the fp16
@@ -217,6 +229,15 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Scale grads by 1/batch_size, aggregate across devices, update."""
+        if _obs.introspect.PROFILING:
+            # MXTPU_PROFILE window: step-bounded jax.profiler capture,
+            # each covered step wrapped in a StepTraceAnnotation
+            with _obs.introspect.profile_step():
+                return self._step_instrumented(batch_size,
+                                               ignore_stale_grad)
+        return self._step_instrumented(batch_size, ignore_stale_grad)
+
+    def _step_instrumented(self, batch_size, ignore_stale_grad):
         if not _obs.ENABLED:
             self._step_impl(batch_size, ignore_stale_grad)
             return
@@ -660,11 +681,27 @@ class Trainer:
         ovf_in = scaler._overflow_total_arr if plan["amp"] \
             else plan["amp_neutral"][2]
         handles = plan["handles"]
-        new_ws, new_sts, gnorm, new_scale, new_unsk, new_ovf = plan["fn"](
-            [h.data for h in handles], [g.data for g in plan["grads"]],
-            plan["states"], lr, wd, rescale, clip,
-            plan["lr_mults"], plan["wd_mults"], scale_in, div_in,
-            unsk_in, ovf_in)
+        args = ([h.data for h in handles],
+                [g.data for g in plan["grads"]],
+                plan["states"], lr, wd, rescale, clip,
+                plan["lr_mults"], plan["wd_mults"], scale_in, div_in,
+                unsk_in, ovf_in)
+        if _obs.flight.INSTALLED or _obs.introspect.PROFILING \
+                or _obs.introspect.ENABLED:
+            if _obs.introspect.ENABLED and not plan.get("introspected"):
+                # cost/memory analysis once per plan, from the aval
+                # skeleton (the call below donates the live buffers)
+                plan["introspected"] = True
+                _obs.introspect.register_jit(
+                    "trainer_fused", plan["fn"],
+                    _obs.introspect.avals_of(args),
+                    donated=_fusedstep.DONATE)
+            new_ws, new_sts, gnorm, new_scale, new_unsk, new_ovf = \
+                _dispatch_call("trainer_fused", "mxtpu.fused_update",
+                               plan["fn"], args)
+        else:
+            new_ws, new_sts, gnorm, new_scale, new_unsk, new_ovf = \
+                plan["fn"](*args)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("trainer_fused")
         for h, w in zip(handles, new_ws):
@@ -969,16 +1006,21 @@ class Superstep:
                 new_params = list(mutated)  # aux (BN stats) carried here
                 for pos, w2 in zip(diff_pos, new_ws):
                     new_params[pos] = w2
+                # per-iteration overflow flag rides the scan ys so the
+                # host sees WHICH iteration skipped, not just a per-K
+                # total (in-scan device metrics; zero extra dispatches)
+                it_ovf = jnp.logical_not(finite).astype(jnp.float32) \
+                    if has_amp else jnp.float32(0.0)
                 if has_amp:
                     scale, unsk, ovf = _amp_scale_step(
                         finite, scale, unsk, ovf, amp_factor, amp_window)
                 return (new_params, new_sts, scale, unsk, ovf), \
-                    (lmean, gnorm)
+                    (lmean, gnorm, it_ovf)
 
-            (params, sts, scale, unsk, ovf), (losses, gnorms) = \
+            (params, sts, scale, unsk, ovf), (losses, gnorms, it_ovfs) = \
                 jax.lax.scan(body, (params, sts, scale, unsk, ovf),
                              (xs, ys, keys))
-            return params, sts, scale, unsk, ovf, losses, gnorms
+            return params, sts, scale, unsk, ovf, losses, gnorms, it_ovfs
 
         fn = jax.jit(superstep_fn,
                      donate_argnums=(0, 1) if _fusedstep.DONATE else ())
@@ -1119,7 +1161,7 @@ class Superstep:
                 plan["lr_mults"], plan["wd_mults"])
         t0 = time.perf_counter()
         try:
-            out = plan["fn"](*args)
+            out = self._dispatch(plan, args, k)
         except Exception as e:
             # no update was applied: roll back the count advance so the
             # scheduler/update bookkeeping stays true to what actually
@@ -1145,7 +1187,7 @@ class Superstep:
             return NDArray(jnp.stack([l.data for l in losses]))
         plan["warm"] = True
         new_params, new_sts, new_scale, new_unsk, new_ovf, losses, \
-            gnorms = out
+            gnorms, it_ovfs = out
         t1 = time.perf_counter()
         for h, w in zip(handles, new_params):
             h._set_data(w)
@@ -1162,9 +1204,31 @@ class Superstep:
         if _obs.ENABLED:
             _obs.record_xla_dispatch("superstep")
             _obs.record_superstep(k, t0, t1, gnorms[-1])
+            # per-iteration in-scan series (loss / grad-norm / overflow
+            # flag), stored WHOLE and LAZY — per-step metric cadence at
+            # K-step dispatch cadence, zero added dispatches
+            _obs.record_superstep_series(losses, gnorms, it_ovfs)
             if plan["amp"]:
                 _obs.record_amp_lazy(scaler._scale_arr, new_ovf)
         return NDArray(losses)
+
+    def _dispatch(self, plan, args, k):
+        """One compiled superstep invocation, with the optional slow-
+        path instrumentation (cost registration, profiler window,
+        flight-recorder in-flight marking) kept off the default path."""
+        intro = _obs.introspect
+        if not (intro.ENABLED or intro.PROFILING or _obs.flight.INSTALLED):
+            return plan["fn"](*args)
+        if intro.ENABLED and not plan.get("introspected"):
+            plan["introspected"] = True
+            intro.register_jit("superstep", plan["fn"],
+                               intro.avals_of(args),
+                               donated=_fusedstep.DONATE)
+        prof = intro.profile_step(k, name="superstep") if intro.PROFILING \
+            else contextlib.nullcontext()
+        with prof:
+            return _dispatch_call("superstep", "mxtpu.superstep",
+                                  plan["fn"], args)
 
     # -- fallback / tail -------------------------------------------------
     def run_single(self, batches, batch_size):

@@ -18,6 +18,13 @@ from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase, register_kvstore
 
 
+import contextlib as _contextlib
+
+#: reusable no-op context for the profiler-span guards below (a
+#: nullcontext instance is reentrant and allocation-free at the sites)
+_NULL_CTX = _contextlib.nullcontext()
+
+
 def _nd_nbytes(v) -> int:
     """Payload bytes of one NDArray-like (0 when unknowable)."""
     try:
@@ -264,14 +271,29 @@ class KVStoreLocal(KVStoreBase):
             if _obs.ENABLED:
                 _obs.KV_BUCKET_BUILD_TOTAL.inc()
 
+        intro = _obs.introspect
         if plan["fused"] is not None:
-            merged = plan["fused"](raw_groups)
+            if intro.ENABLED and not intro.registered("kv_bucket"):
+                intro.register_jit("kv_bucket", plan["fused"],
+                                   (intro.avals_of(raw_groups),))
+            with intro.annotate("mxtpu.grad_bucket") if intro.PROFILING \
+                    else _NULL_CTX:
+                merged = plan["fused"](raw_groups)
             n_dispatch = 1
         else:
-            bucket_arrs = plan["pack"](raw_groups)
+            if intro.ENABLED and not intro.registered("kv_bucket_pack"):
+                intro.register_jit("kv_bucket_pack", plan["pack"],
+                                   (intro.avals_of(raw_groups),))
+            prof = intro.PROFILING
+            with intro.annotate("mxtpu.grad_pack") if prof else _NULL_CTX:
+                bucket_arrs = plan["pack"](raw_groups)
             reduce_live = not self._reduce_raw_is_identity()
-            bucket_arrs = tuple(self._reduce_raw(b) for b in bucket_arrs)
-            merged = plan["unpack"](bucket_arrs)
+            with intro.annotate("mxtpu.grad_allreduce") if prof \
+                    else _NULL_CTX:
+                bucket_arrs = tuple(self._reduce_raw(b)
+                                    for b in bucket_arrs)
+            with intro.annotate("mxtpu.grad_unpack") if prof else _NULL_CTX:
+                merged = plan["unpack"](bucket_arrs)
             n_dispatch = 2 + (len(bucket_arrs) if reduce_live else 0)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("kv_bucket", n_dispatch)

@@ -19,6 +19,17 @@ Switch: ``MXTPU_TELEMETRY=1`` at process start, or
 ``observability.set_enabled(True)`` at runtime. Off by default: the
 disabled cost at every site is a single module-attribute boolean read.
 
+Sibling layers (docs/observability.md "Profiling & post-mortem"):
+
+- ``observability.introspect`` — per-executable XLA cost/memory
+  accounting + MFU/roofline estimation (``MXTPU_INTROSPECT``) and
+  step-bounded ``jax.profiler`` windows (``MXTPU_PROFILE``),
+- ``observability.flight`` — crash flight recorder
+  (``MXTPU_DUMP_ON_CRASH``): excepthook + SIGTERM/SIGABRT handlers
+  dumping trace ring, metrics, cost table and in-flight dispatch sites,
+- ``observability.serve`` — background-thread Prometheus endpoint
+  (``MXTPU_METRICS_PORT`` / ``serve_metrics(port)``).
+
 Quickstart::
 
     import mxnet_tpu as mx
@@ -39,6 +50,7 @@ from .metrics import (  # noqa: F401
     Gauge,
     Histogram,
     MetricsRegistry,
+    SeriesGauge,
     DEFAULT_BUCKETS,
 )
 from .tracing import Span, Tracer, load_jsonl  # noqa: F401
@@ -220,6 +232,54 @@ AMP_OVERFLOW_TOTAL = _REGISTRY.gauge(
     "scaler was created — monotonic; a gauge, not a counter, so the "
     "fused step can record the in-graph total as a lazy device scalar")
 
+# -- executable introspection (MXTPU_INTROSPECT; observability/introspect) --
+
+EXEC_FLOPS = _REGISTRY.gauge(
+    "mxtpu_executable_flops",
+    "XLA cost-analysis FLOPs per invocation of the compiled executable "
+    "at each site (a superstep site's figure covers its K iterations)")
+EXEC_BYTES_ACCESSED = _REGISTRY.gauge(
+    "mxtpu_executable_bytes_accessed",
+    "XLA cost-analysis bytes accessed (HBM traffic) per invocation, "
+    "by site")
+EXEC_ARITH_INTENSITY = _REGISTRY.gauge(
+    "mxtpu_executable_arith_intensity",
+    "flops / bytes_accessed per site — position on the roofline "
+    "(compare against the device ridge point; docs/observability.md)")
+EXEC_TEMP_BYTES = _REGISTRY.gauge(
+    "mxtpu_executable_temp_bytes",
+    "XLA memory-analysis temp allocation of the executable, by site")
+EXEC_ARG_BYTES = _REGISTRY.gauge(
+    "mxtpu_executable_argument_bytes",
+    "XLA memory-analysis argument bytes of the executable, by site")
+EXEC_OUT_BYTES = _REGISTRY.gauge(
+    "mxtpu_executable_output_bytes",
+    "XLA memory-analysis output bytes of the executable, by site")
+EXEC_ALIAS_BYTES = _REGISTRY.gauge(
+    "mxtpu_executable_alias_bytes",
+    "bytes the compiled program aliased input->output (donation "
+    "actually taking effect), by site")
+DONATION_UNALIASED_TOTAL = _REGISTRY.counter(
+    "mxtpu_donation_unaliased_total",
+    "executables that donated buffers but aliased 0 bytes — the "
+    "donation silently failed (also warned once per site)")
+
+# -- in-scan superstep device metrics (per-iteration, K-slot series) -------
+
+SUPERSTEP_ITER_LOSS = _REGISTRY.series_gauge(
+    "mxtpu_superstep_iter_loss",
+    "per-iteration mean loss of the LAST superstep dispatch, one slot "
+    "per scan iteration (lazy device array; syncs only when read) — "
+    "K-step capture keeps per-step metric cadence")
+SUPERSTEP_ITER_GRAD_NORM = _REGISTRY.series_gauge(
+    "mxtpu_superstep_iter_grad_norm",
+    "per-iteration in-graph global grad norm of the last superstep "
+    "dispatch, one slot per scan iteration (lazy device array)")
+SUPERSTEP_ITER_OVERFLOW = _REGISTRY.series_gauge(
+    "mxtpu_superstep_iter_overflow",
+    "per-iteration fp16 overflow flag (1 = that iteration skipped its "
+    "update) of the last superstep dispatch (lazy device array)")
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)
@@ -309,6 +369,30 @@ def record_superstep(k: int, t0: float, t1: float, grad_norm=None):
         step = _TRACER.mark_step()
     _TRACER.record("trainer.superstep", cat="trainer", ts=t0, dur=dt,
                    args={"k": int(k), "step": step})
+
+
+def record_superstep_series(losses, gnorms=None, overflows=None):
+    """Publish the per-iteration device series one superstep dispatch
+    produced (scan ys: loss, in-graph grad norm, fp16 overflow flag).
+    The arrays are stored WHOLE and LAZY — no slicing, no sync, zero
+    added dispatches on the hot path; elements materialize only when a
+    series gauge is read (summary/exposition/``superstep_series()``).
+    This is what keeps K-step capture at per-step metric cadence."""
+    SUPERSTEP_ITER_LOSS.set_series(losses)
+    if gnorms is not None:
+        SUPERSTEP_ITER_GRAD_NORM.set_series(gnorms)
+    if overflows is not None:
+        SUPERSTEP_ITER_OVERFLOW.set_series(overflows)
+
+
+def superstep_series() -> dict:
+    """The last superstep's per-iteration metrics as plain float lists
+    (one device sync per series, here at read time): ``{"loss": [...],
+    "grad_norm": [...], "overflow": [...]}`` — empty lists before the
+    first superstep (or for series the capture did not produce)."""
+    return {"loss": SUPERSTEP_ITER_LOSS.series(),
+            "grad_norm": SUPERSTEP_ITER_GRAD_NORM.series(),
+            "overflow": SUPERSTEP_ITER_OVERFLOW.series()}
 
 
 def record_amp_scale(scale, overflow_total, overflow: bool):
@@ -435,6 +519,29 @@ def summary() -> str:
     if len(lines) == 1:
         lines.append("  (no events recorded)")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# performance introspection / crash flight recorder / scrape endpoint
+# (submodules bind as attributes: observability.introspect / .flight)
+# ---------------------------------------------------------------------------
+
+from . import flight  # noqa: E402,F401
+from . import introspect  # noqa: E402,F401
+from .introspect import (  # noqa: E402,F401
+    cost_table,
+    mfu_estimate,
+    profile_window,
+)
+from .serve import (  # noqa: E402,F401
+    metrics_port,
+    serve_metrics,
+    stop_metrics_server,
+)
+
+# MXTPU_DUMP_ON_CRASH: hooks install at import (opt-in via env only —
+# without the var this is a dict read and nothing else)
+flight.maybe_install()
 
 
 def __getattr__(name):

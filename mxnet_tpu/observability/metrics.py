@@ -183,11 +183,59 @@ class Histogram(Metric):
         return lines
 
 
+class SeriesGauge(Metric):
+    """A gauge whose value is a short per-slot SERIES — the in-scan
+    device metrics a K-step superstep publishes once per dispatch
+    (per-iteration loss / grad-norm / overflow). ``set_series`` stores
+    the whole device array WITHOUT slicing or syncing (one lazy array,
+    zero added dispatches on the hot path); elements materialize at
+    read/exposition time only, exposed per-slot as
+    ``name{slot="i"}``."""
+
+    kind = "gauge"
+
+    def set_series(self, values, **labels):
+        """Store a 1-D array/list of per-slot values (device arrays
+        stay lazy — ``tolist()`` happens only when read)."""
+        self._values[_label_key(labels)] = values
+
+    def series(self, **labels) -> list:
+        """The stored series as plain floats (syncs a device array)."""
+        v = self._values.get(_label_key(labels))
+        if v is None:
+            return []
+        if hasattr(v, "tolist"):
+            v = v.tolist()
+        return [float(x) for x in v]
+
+    def value(self, **labels) -> float:
+        """Last slot of the series (the most recent iteration)."""
+        s = self.series(**labels)
+        return s[-1] if s else 0.0
+
+    def total(self) -> float:
+        return float(sum(sum(self.series(**dict(k)))
+                         for k in list(self._values)))
+
+    def expose(self) -> list:
+        lines = []
+        if self.help:
+            lines.append(f"# HELP {self.name} {self.help}")
+        lines.append(f"# TYPE {self.name} {self.kind}")
+        for key in sorted(self._values):
+            for i, x in enumerate(self.series(**dict(key))):
+                slot = f'slot="{i}"'
+                lines.append(f"{self.name}{_fmt_labels(key, slot)} "
+                             f"{_fmt_value(x)}")
+        return lines
+
+
 class MetricsRegistry:
     """Named collection of metrics; one process-global default instance
     lives in ``mxnet_tpu.observability``."""
 
-    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
+              "series_gauge": SeriesGauge}
 
     def __init__(self):
         self._metrics = {}
@@ -214,6 +262,9 @@ class MetricsRegistry:
 
     def histogram(self, name, help="", buckets=None) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def series_gauge(self, name, help="") -> SeriesGauge:
+        return self._get_or_create(SeriesGauge, name, help)
 
     def get(self, name):
         return self._metrics.get(name)

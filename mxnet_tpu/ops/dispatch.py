@@ -48,6 +48,15 @@ def _run_timed(opdef, fn, raw):
     from .. import profiler
 
     aggregate = profiler.aggregate_enabled()
+    if not (aggregate or _obs.ENABLED or _obs.introspect.ENABLED):
+        return fn(*raw)
+    if _obs.introspect.ENABLED and hasattr(fn, "lower") \
+            and not _obs.introspect.registered(f"op[{opdef.name}]"):
+        # per-(op) executable cost/memory accounting — one registration
+        # covers every later call of the op (first attrs-variant wins);
+        # non-jittable ops (data-dependent shapes) have no executable
+        _obs.introspect.register_jit(
+            f"op[{opdef.name}]", fn, _obs.introspect.avals_of(tuple(raw)))
     if not (aggregate or _obs.ENABLED):
         return fn(*raw)
     import time

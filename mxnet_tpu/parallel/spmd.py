@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import autograd
+from .. import observability as _obs
 from .. import random as _random
 from ..base import MXNetError
 from ..gluon.block import _TRACE_STATE
@@ -475,8 +476,19 @@ class SPMDTrainStep:
         # path free of an O(n_params) tree_map per step)
         self._io_avals = (raw_x.shape, raw_x.dtype, raw_y.shape, raw_y.dtype,
                           lr_arr.dtype, key)
-        new_params, new_states, loss = self._compiled(
-            params, opt_states, raw_x, raw_y, lr_arr, key)
+        args = (params, opt_states, raw_x, raw_y, lr_arr, key)
+        if _obs.introspect.ENABLED \
+                and not _obs.introspect.registered("spmd_step"):
+            _obs.introspect.register_jit(
+                "spmd_step", self._compiled,
+                _obs.introspect.avals_of(args), donated=self._donate)
+        if _obs.flight.INSTALLED:
+            with _obs.flight.dispatch("spmd_step"):
+                new_params, new_states, loss = self._compiled(*args)
+        else:
+            new_params, new_states, loss = self._compiled(*args)
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("spmd_step")
         self._state = (new_params, new_states)
         return float(loss) if sync else loss
 
@@ -525,6 +537,8 @@ class SPMDTrainStep:
         new_params, new_states, loss = self._run_many(
             params, opt_states, raw_x, raw_y, lr_arr, base_key,
             self._last_loss, jnp.asarray(n, jnp.int32))
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("spmd_step")
         self._state = (new_params, new_states)
         self._last_loss = loss
         return loss
@@ -589,8 +603,21 @@ class SPMDTrainStep:
         k = int(raw_x.shape[0])
         keys = jax.random.split(base_key, k)
         params, opt_states = self._state
-        new_params, new_states, losses = self._run_super(
-            params, opt_states, raw_x, raw_y, lr_arr, keys)
+        args = (params, opt_states, raw_x, raw_y, lr_arr, keys)
+        if _obs.introspect.ENABLED \
+                and not _obs.introspect.registered("spmd_superstep"):
+            _obs.introspect.register_jit(
+                "spmd_superstep", self._run_super,
+                _obs.introspect.avals_of(args), donated=self._donate)
+        if _obs.flight.INSTALLED:
+            with _obs.flight.dispatch("spmd_superstep"):
+                new_params, new_states, losses = self._run_super(*args)
+        else:
+            new_params, new_states, losses = self._run_super(*args)
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("spmd_superstep")
+            # per-iteration in-scan loss series, stored whole and lazy
+            _obs.record_superstep_series(losses)
         self._state = (new_params, new_states)
         self._last_loss = losses[-1]
         return losses

@@ -100,6 +100,10 @@ class Features(dict):
             # tests/test_large_tensor.py; docs/design_decisions.md)
             "INT64_TENSOR_SIZE": bool(jax.config.jax_enable_x64),
             "COMPILE_CACHE": _CACHE_STATE["dir"] is not None,
+            # XLA cost/memory analysis + MFU/roofline estimation
+            # (observability.introspect); the estimator checks this
+            # feature and degrades to null-with-reason when disabled
+            "INTROSPECTION": True,
             "SIGNAL_HANDLER": True,
             "F16C": True,
             "BF16": True,

@@ -32,6 +32,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR5_OUT"] = str(tmp_path / "BENCH_pr5.json")
     env["BENCH_PR6_OUT"] = str(tmp_path / "BENCH_pr6.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
+    env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
 
 
@@ -110,3 +111,24 @@ def test_bench_emits_driver_contract(tmp_path):
     assert status["rc"] == 0, status
     assert "amp" in status["completed"] and "superstep" in \
         status["completed"] and not status["failed"], status
+    # MFU accounting contract (PR7): EVERY row carries flops_per_step
+    # and mfu; a null always pairs with a reason (this CPU smoke has no
+    # peak table, so mfu is null-with-reason while flops_per_step is
+    # real on the cost-analysis-backed rows)
+    for rec in recs:
+        assert "flops_per_step" in rec and "mfu" in rec, rec
+        if rec["mfu"] is None:
+            assert rec.get("mfu_reason"), rec
+    fused = [r for r in recs if r["metric"].startswith("train_step_fused")]
+    assert fused and fused[0]["flops_per_step"] > 0, fused
+    assert ss[0]["flops_per_step"] > 0, ss  # superstep scan FLOPs / K
+    # the bench telemetry dump feeds the report tool's roofline table
+    tel = tmp_path / "BENCH_telemetry.jsonl"
+    assert tel.exists()
+    import subprocess as sp
+    rep = sp.run([sys.executable,
+                  os.path.join(ROOT, "tools", "telemetry_report.py"),
+                  str(tel)], capture_output=True, text=True, timeout=60)
+    assert rep.returncode == 0, rep.stderr
+    assert "Executable roofline" in rep.stdout, rep.stdout[-2000:]
+    assert "superstep" in rep.stdout

@@ -1,0 +1,228 @@
+"""Crash flight recorder (observability/flight.py): bundle contents,
+in-flight dispatch tracking, hook install/uninstall hygiene, and the
+end-to-end contract — killing a training run mid-step with
+MXTPU_DUMP_ON_CRASH set produces a parseable bundle (via subprocess,
+for both SIGTERM and an unhandled exception)."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, observability as obs
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import flight, introspect
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.set_enabled(False)
+    obs.reset()
+    introspect.set_enabled(False)
+    introspect.reset()
+    yield
+    flight.uninstall()
+    obs.set_enabled(False)
+    obs.reset()
+    introspect.set_enabled(False)
+    introspect.reset()
+
+
+def _train_steps(n=2):
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=None)
+    X, Y = mx.nd.ones((8, 8)), mx.nd.zeros((8,))
+    for _ in range(n):
+        with autograd.record():
+            l = loss_fn(net(X), Y)
+        l.backward()
+        tr.step(8)
+
+
+# ---------------------------------------------------------------------------
+# in-process bundle
+# ---------------------------------------------------------------------------
+
+def test_manual_dump_bundle_contents(tmp_path):
+    flight.install(str(tmp_path))
+    obs.set_enabled(True)
+    introspect.set_enabled(True)
+    _train_steps()
+    path = flight.dump(reason="manual-test")
+    assert path and os.path.exists(path)
+    b = json.load(open(path))
+    assert b["format"] == "mxtpu-flight-recorder-v1"
+    assert b["reason"] == "manual-test"
+    assert b["step"] >= 2
+    assert b["trace_events"] and all("name" in ev
+                                     for ev in b["trace_events"])
+    assert "trainer_fused" in b["executables"]
+    assert b["executables"]["trainer_fused"]["flops"] > 0
+    assert "mxtpu_trainer_step_total" in b["metrics"]
+    assert b["in_flight"] == {}
+    assert b["backend"] is not None
+
+
+def test_dump_without_dir_returns_none():
+    assert flight.dump(reason="nowhere") is None
+
+
+def test_in_flight_tracking():
+    with flight.dispatch("t_site"):
+        with flight.dispatch("t_site"):
+            assert flight.in_flight() == {"t_site": 2}
+        assert flight.in_flight() == {"t_site": 1}
+    assert flight.in_flight() == {}
+
+
+def test_in_flight_captured_in_bundle(tmp_path):
+    flight.install(str(tmp_path))
+    with flight.dispatch("trainer_fused"):
+        b = flight.build_bundle("probe")
+    assert b["in_flight"] == {"trainer_fused": 1}
+
+
+def test_install_uninstall_restores_hooks(tmp_path):
+    prev_hook = sys.excepthook
+    prev_term = signal.getsignal(signal.SIGTERM)
+    flight.install(str(tmp_path))
+    assert flight.INSTALLED
+    assert sys.excepthook is not prev_hook
+    flight.install(str(tmp_path))  # idempotent
+    flight.uninstall()
+    assert not flight.INSTALLED
+    assert sys.excepthook is prev_hook
+    assert signal.getsignal(signal.SIGTERM) == prev_term
+    flight.uninstall()  # idempotent too
+
+
+def test_bundle_survives_lazy_device_gauges(tmp_path):
+    """Lazy device scalars stored by the fused step must serialize
+    (synced at dump time), not crash the JSON encoder."""
+    import jax.numpy as jnp
+
+    flight.install(str(tmp_path))
+    obs.TRAINER_GRAD_NORM.set_lazy(jnp.float32(3.5))
+    path = flight.dump(reason="lazy")
+    b = json.load(open(path))
+    assert b["metrics"]["mxtpu_trainer_grad_norm"]["values"][""] == 3.5
+
+
+# ---------------------------------------------------------------------------
+# subprocess: the real crash paths
+# ---------------------------------------------------------------------------
+
+_CHILD = """
+import os, sys, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {root!r})
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+net = nn.Dense(4, in_units=8)
+net.initialize(); net.hybridize()
+tr = gluon.Trainer(net.collect_params(), "sgd",
+                   {{"learning_rate": 0.1}}, kvstore=None)
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+X, Y = mx.nd.ones((8, 8)), mx.nd.zeros((8,))
+
+def one():
+    with autograd.record():
+        l = loss_fn(net(X), Y)
+    l.backward()
+    tr.step(8)
+
+one(); one()  # warm: compile + register executables
+open({ready!r}, "w").write("ready")
+i = 0
+while True:
+    one()
+    i += 1
+    if {raise_at} and i >= {raise_at}:
+        raise RuntimeError("mid-training crash for the recorder test")
+    time.sleep(0.001)
+"""
+
+
+def _spawn(tmp_path, raise_at=0):
+    dump_dir = tmp_path / "dumps"
+    ready = str(tmp_path / "ready")
+    env = dict(os.environ)
+    env.update(MXTPU_DUMP_ON_CRASH=str(dump_dir), MXTPU_TELEMETRY="1",
+               MXTPU_INTROSPECT="1")
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _CHILD.format(root=ROOT, ready=ready, raise_at=raise_at)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    return proc, dump_dir, ready
+
+
+def _wait_ready(proc, ready, timeout=120):
+    t0 = time.monotonic()
+    while not os.path.exists(ready):
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"child died early: {proc.stderr.read().decode()[-2000:]}")
+        if time.monotonic() - t0 > timeout:
+            proc.kill()
+            raise AssertionError("child never became ready")
+        time.sleep(0.05)
+
+
+def _read_bundle(dump_dir):
+    files = glob.glob(str(dump_dir / "flight_*.json"))
+    assert len(files) == 1, files
+    return json.load(open(files[0]))
+
+
+def test_sigterm_mid_training_writes_bundle(tmp_path):
+    """The acceptance path: kill a live training loop with SIGTERM and
+    get a parseable bundle with the last trace events and the
+    executable cost table."""
+    proc, dump_dir, ready = _spawn(tmp_path)
+    try:
+        _wait_ready(proc, ready)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # the handler re-raises the signal after dumping, so the parent
+    # sees a true SIGTERM death, not a clean exit
+    assert proc.returncode == -signal.SIGTERM, proc.returncode
+    b = _read_bundle(dump_dir)
+    assert b["reason"] == "signal: SIGTERM"
+    names = {ev["name"] for ev in b["trace_events"]}
+    assert "trainer.step" in names
+    assert b["executables"].get("trainer_fused", {}).get("flops")
+    assert b["step"] > 0
+    assert b["env"].get("MXTPU_DUMP_ON_CRASH")
+
+
+def test_unhandled_exception_writes_bundle(tmp_path):
+    proc, dump_dir, ready = _spawn(tmp_path, raise_at=3)
+    try:
+        _wait_ready(proc, ready)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 1  # the original traceback still exits 1
+    assert b"mid-training crash" in proc.stderr.read()
+    b = _read_bundle(dump_dir)
+    assert b["reason"].startswith("exception: RuntimeError")
+    assert "trainer_fused" in b["executables"]

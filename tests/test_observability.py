@@ -385,3 +385,170 @@ def test_callback_telemetry_logger(caplog):
         cb(0, None, None, None)  # epoch_end_callback signature
     assert "telemetry summary" in caplog.text
     assert "[Epoch 0]" in caplog.text
+
+
+# ---------------------------------------------------------------------------
+# Prometheus histogram exposition spec (PR7 satellite): cumulative
+# bucket counts, an explicit +Inf bucket equal to _count, and the
+# _sum/_count series — the format prometheus scrapers actually require
+# ---------------------------------------------------------------------------
+
+def test_histogram_prometheus_spec_compliance():
+    reg = obs.MetricsRegistry()
+    h = reg.histogram("t_lat_seconds", "spec probe",
+                      buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    h.observe(0.5, route="a")
+    lines = h.expose()
+    assert lines.count("# TYPE t_lat_seconds histogram") == 1
+
+    def val(line):
+        return float(line.rsplit(" ", 1)[1])
+
+    # unlabeled series: cumulative, monotonically non-decreasing counts
+    unl = [ln for ln in lines
+           if ln.startswith('t_lat_seconds_bucket{le=')]
+    assert [val(ln) for ln in unl] == [1, 3, 4, 5]
+    assert unl[-1].startswith('t_lat_seconds_bucket{le="+Inf"}')
+    # +Inf bucket == _count, and _sum is the exact observation sum
+    assert val([ln for ln in lines
+                if ln.startswith("t_lat_seconds_count ")][0]) == 5
+    assert val([ln for ln in lines
+                if ln.startswith("t_lat_seconds_sum ")][0]) \
+        == pytest.approx(56.05)
+    # labeled series carry their labels plus le, same cumulative rule
+    lab = [ln for ln in lines
+           if ln.startswith('t_lat_seconds_bucket{route="a"')]
+    assert [val(ln) for ln in lab] == [0, 1, 1, 1]
+    assert 'le="+Inf"' in lab[-1]
+    assert val([ln for ln in lines if ln.startswith(
+        't_lat_seconds_count{route="a"}')][0]) == 1
+
+
+def test_series_gauge_lazy_array_semantics():
+    import jax.numpy as jnp
+
+    reg = obs.MetricsRegistry()
+    s = reg.series_gauge("t_iter_series", "per-slot probe")
+    s.set_series(jnp.asarray([1.0, 2.0, 3.0]))  # stored lazy, whole
+    assert s.series() == [1.0, 2.0, 3.0]
+    assert s.value() == 3.0  # last slot
+    assert s.total() == 6.0
+    lines = s.expose()
+    assert 't_iter_series{slot="0"} 1' in lines
+    assert 't_iter_series{slot="2"} 3' in lines
+    s.set_series([5.0])  # plain lists work too; old slots drop
+    assert s.series() == [5.0]
+    assert len([ln for ln in s.expose() if "slot=" in ln]) == 1
+
+
+# ---------------------------------------------------------------------------
+# scrape endpoint (PR7 satellite): /metrics + /healthz on a
+# background thread, idempotent shutdown
+# ---------------------------------------------------------------------------
+
+def test_serve_metrics_endpoint_and_idempotent_shutdown():
+    import urllib.error
+    import urllib.request
+
+    port = obs.serve_metrics(0)  # ephemeral
+    try:
+        assert obs.metrics_port() == port
+        # idempotent start: same port back, no second server
+        assert obs.serve_metrics(0) == port
+        obs.registry().counter("t_http_probe_total").inc(7)
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        assert "t_http_probe_total 7" in body
+        assert "mxtpu_trainer_step_total" in body  # whole catalog served
+        hz = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10)
+        assert hz.read() == b"ok\n"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10)
+    finally:
+        obs.stop_metrics_server()
+        obs.stop_metrics_server()  # idempotent
+    assert obs.metrics_port() is None
+    # restartable after shutdown
+    p2 = obs.serve_metrics(0)
+    try:
+        assert p2
+    finally:
+        obs.stop_metrics_server()
+
+
+# ---------------------------------------------------------------------------
+# telemetry-overhead regression (PR7 satellite): MXTPU_TELEMETRY=1 must
+# add ZERO XLA dispatches to the fused loop (the in-graph grad norm is
+# a lazy device scalar, not an extra executable) and bounded wall cost
+# ---------------------------------------------------------------------------
+
+def test_telemetry_adds_zero_dispatches_and_bounded_wall():
+    import time as _time
+
+    from mxnet_tpu import autograd as ag, engine, gluon as gl
+
+    loss_fn = gl.loss.SoftmaxCrossEntropyLoss()
+    net = _tiny_net()
+    net.hybridize()
+    tr = gl.Trainer(net.collect_params(), "sgd",
+                    {"learning_rate": 0.05, "momentum": 0.9},
+                    kvstore=None)
+    X, Y = mx.nd.ones((8, 8)), mx.nd.zeros((8,))
+
+    def one():
+        with ag.record():
+            l = loss_fn(net(X), Y)
+        l.backward()
+        tr.step(8)
+        return l
+
+    def timed(n):
+        t0 = _time.perf_counter()
+        l = None
+        for _ in range(n):
+            l = one()
+        engine.wait(l.data)
+        return _time.perf_counter() - t0
+
+    N = 30
+    one(); engine.wait(one().data)      # warm (telemetry off)
+    t_off = timed(N)
+    obs.set_enabled(True)
+    # telemetry flips the CachedOp key + fused-plan signature: one
+    # warm step absorbs the rebuild before counting
+    one(); engine.wait(one().data)
+    c0 = obs.XLA_DISPATCH_TOTAL.total()
+    engine.wait(one().data)
+    per_step = obs.XLA_DISPATCH_TOTAL.total() - c0  # steady-state cost
+    c0 = obs.XLA_DISPATCH_TOTAL.total()
+    fused0 = obs.XLA_DISPATCH_TOTAL.value(site="trainer_fused")
+    op0 = obs.XLA_DISPATCH_TOTAL.value(site="op")
+    t_on = timed(N)
+    delta = obs.XLA_DISPATCH_TOTAL.total() - c0
+    # telemetry dispatches NOTHING of its own: every step costs exactly
+    # the steady-state constant (the grad-norm gauge rides the fused
+    # executable as a lazy scalar — no probe executable, no sync), and
+    # the fused trio stays one dispatch per site per step. The only
+    # `op` dispatches are the un-hybridized loss block's own eager ops
+    # (a property of the loop, identical with telemetry off).
+    assert delta == per_step * N, (delta, per_step, N)
+    assert obs.XLA_DISPATCH_TOTAL.value(site="trainer_fused") \
+        - fused0 == N
+    assert (obs.XLA_DISPATCH_TOTAL.value(site="op") - op0) \
+        == (per_step - 3) * N  # fwd + bwd + fused update = the 3
+    # bounded wall overhead; re-measure BOTH legs once before failing —
+    # CI host pressure must not masquerade as a telemetry regression
+    # (and the retry baseline must really run telemetry-OFF, or the
+    # retry would compare on-vs-on and the gate would be vacuous)
+    if t_on > 4.0 * t_off:
+        obs.set_enabled(False)
+        engine.wait(one().data)  # re-warm the off-keyed executables
+        t_off = timed(N)
+        obs.set_enabled(True)
+        engine.wait(one().data)
+        t_on = timed(N)
+    assert t_on < 4.0 * t_off, (t_on, t_off)

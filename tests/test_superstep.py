@@ -639,3 +639,65 @@ def test_spmd_run_superstep_parity():
         s2.sync_to_block()
         for a, b in zip(_weights(net1), _weights(net2)):
             np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# in-scan device metrics (PR7): per-iteration loss/grad-norm/overflow
+# series at K=8 with zero added dispatches — K-step capture no longer
+# reduces metric cadence to K
+# ---------------------------------------------------------------------------
+
+def test_superstep_per_iteration_series_zero_added_dispatches():
+    prev = obs.set_enabled(True)
+    obs.reset()
+    try:
+        net, tr = _build("sgd")
+        ss = gluon.Superstep(net, loss_fn, tr, k=8)
+        xs = stack_batches([_batch(i)[0] for i in range(8)])
+        ys = stack_batches([_batch(i)[1] for i in range(8)])
+        ss.step(xs, ys, 16)  # warm: capture + compile
+        assert isinstance(ss._plan, dict)
+        before = obs.XLA_DISPATCH_TOTAL.total()
+        ss.step(xs, ys, 16)
+        # the K=8 superstep is STILL one dispatch — publishing the
+        # per-iteration series stores the scan's stacked outputs whole
+        # (lazy), never slicing or syncing on the hot path
+        assert obs.XLA_DISPATCH_TOTAL.total() - before == 1
+        series = obs.superstep_series()
+        assert len(series["loss"]) == 8
+        assert len(series["grad_norm"]) == 8
+        assert len(series["overflow"]) == 8
+        assert all(np.isfinite(series["loss"]))
+        assert all(g > 0 for g in series["grad_norm"])
+        assert series["overflow"] == [0.0] * 8
+        # per-slot exposition for scrapers
+        expo = obs.dump_prometheus()
+        assert 'mxtpu_superstep_iter_loss{slot="7"}' in expo
+        assert 'mxtpu_superstep_iter_grad_norm{slot="0"}' in expo
+    finally:
+        obs.set_enabled(prev)
+        obs.reset()
+
+
+def test_superstep_overflow_series_marks_poisoned_iteration():
+    """fp16 in-scan AMP: the overflow series points at the exact
+    iteration that skipped its update (slot 1 of 4), not just a per-K
+    total."""
+    amp.init("float16")
+    prev = obs.set_enabled(True)
+    obs.reset()
+    try:
+        net, tr = _build("sgd", amp_dtype="float16")
+        ss = gluon.Superstep(net, loss_fn, tr, k=4)
+        xs = stack_batches([_batch(i, dtype="float16",
+                                   poison=(i == 1))[0] for i in range(4)])
+        ys = stack_batches([_batch(i)[1] for i in range(4)])
+        ss.step(xs, ys, 16)
+        assert isinstance(ss._plan, dict)
+        series = obs.superstep_series()
+        assert series["overflow"] == [0.0, 1.0, 0.0, 0.0]
+        assert tr._amp_loss_scaler.overflow_total == 1
+    finally:
+        amp.disable()
+        obs.set_enabled(prev)
+        obs.reset()

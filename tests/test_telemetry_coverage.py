@@ -1,0 +1,49 @@
+"""Telemetry coverage gate (tools/check_telemetry_coverage.py): every
+metric / trace-series / dispatch-site name emitted in mxnet_tpu/ must
+be documented in docs/observability.md — a new instrumentation site
+cannot land undocumented. Pure static check, no jax needed."""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+import check_telemetry_coverage as ctc  # noqa: E402
+
+sys.path.pop(0)
+
+
+def test_every_emitted_name_is_documented():
+    missing, found = ctc.check(ROOT)
+    assert not missing, (
+        "telemetry names emitted but missing from docs/observability.md "
+        f"coverage map: {missing}")
+    # sanity: the scanner actually sees the catalog (an empty scan
+    # passing would make this gate vacuous)
+    assert len(found["metric"]) >= 30
+    assert "trainer.step" in found["trace"]
+    assert "trainer_fused" in found["site"]
+
+
+def test_scanner_catches_an_undocumented_name(tmp_path):
+    """End-to-end negative case on a synthetic tree: the checker must
+    actually fail when a name is emitted but not documented."""
+    pkg = tmp_path / "mxnet_tpu"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        'C = REG.counter("mxtpu_documented_total")\n'
+        'D = REG.counter("mxtpu_undocumented_total")\n'
+        'tracer.record("my.series", cat="x")\n'
+        'record_xla_dispatch("mystery_site")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "`mxtpu_documented_total` and the `my.series` span\n")
+    missing, _ = ctc.check(str(tmp_path))
+    names = {m[1] for m in missing}
+    assert names == {"mxtpu_undocumented_total", "mystery_site"}
+
+
+def test_cli_exit_codes(capsys):
+    assert ctc.main(["--root", ROOT]) == 0
+    assert "coverage OK" in capsys.readouterr().out

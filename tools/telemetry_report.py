@@ -155,6 +155,70 @@ def render_superstep(events):
         f"(mean K = {steps / len(evs):.1f})"])
 
 
+#: cost-record site -> the span series whose mean duration times it
+#: (a superstep span covers K iterations — and so does its FLOP count,
+#: so the ratio is still per-invocation-consistent)
+_SITE_SPANS = {"trainer_fused": "trainer.step",
+               "superstep": "trainer.superstep"}
+
+
+def render_roofline(events):
+    """Per-site roofline table from ``introspect.cost`` records (one
+    per registered executable; see observability/introspect.py): FLOPs,
+    HBM bytes, arithmetic intensity, compute-vs-memory bound against
+    the device ridge point, and achieved TFLOP/s + MFU where the dump
+    also carries step spans to time the site with. Crash-proof: absent
+    series -> empty string, malformed/partial records render '-' (a
+    backend without cost analysis must never crash the report)."""
+    by_site = {}
+    for ev in events:
+        if ev.get("name") != "introspect.cost":
+            continue
+        args = ev.get("args")
+        if isinstance(args, dict) and args.get("site"):
+            by_site[args["site"]] = args  # last record per site wins
+    if not by_site:
+        return ""
+    spans = aggregate(events)
+
+    def num(rec, key):
+        v = rec.get(key)
+        return float(v) if isinstance(v, (int, float)) else None
+
+    lines = ["", "Executable roofline (XLA cost/memory analysis):",
+             f"{'Site':<34}{'GFLOPs':>10}{'MiB':>9}{'AI':>8}"
+             f"{'Bound':>9}{'TFLOP/s':>10}{'MFU':>8}"]
+    for site in sorted(by_site):
+        rec = by_site[site]
+        flops = num(rec, "flops")
+        nbytes = num(rec, "bytes_accessed")
+        ai = num(rec, "arith_intensity")
+        peak = num(rec, "peak_tflops")
+        bw = num(rec, "peak_hbm_gbs")
+        bound = "-"
+        if ai is not None and peak and bw:
+            ridge = peak * 1e12 / (bw * 1e9)
+            bound = "compute" if ai >= ridge else "memory"
+        achieved = mfu = None
+        span = spans.get(_SITE_SPANS.get(site, ""))
+        if flops is not None and span and span[0]:
+            mean_s = span[1] / span[0] / 1e3  # aggregate() is ms
+            if mean_s > 0:
+                achieved = flops / mean_s / 1e12
+                if peak:
+                    mfu = achieved / peak
+
+        def fmt(v, scale=1.0, nd=2):
+            return f"{v / scale:.{nd}f}" if v is not None else "-"
+
+        lines.append(
+            f"{site:<34}{fmt(flops, 1e9, 3):>10}"
+            f"{fmt(nbytes, 2 ** 20):>9}{fmt(ai, 1.0, 1):>8}"
+            f"{bound:>9}{fmt(achieved, 1.0, 3):>10}"
+            f"{fmt(mfu):>8}")
+    return "\n".join(lines)
+
+
 def render_steps(events):
     """Per-step timeline of trainer.step spans, when present."""
     steps = [ev for ev in events if ev.get("name") == "trainer.step"]
@@ -195,6 +259,9 @@ def main(argv=None):
     sstep = render_superstep(events)
     if sstep:
         print(sstep)
+    roof = render_roofline(events)
+    if roof:
+        print(roof)
     if args.steps:
         out = render_steps(events)
         if out:
