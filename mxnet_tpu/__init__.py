@@ -42,6 +42,7 @@ from . import image  # noqa: F401
 from . import callback  # noqa: F401
 from . import fusedstep  # noqa: F401
 from . import observability  # noqa: F401
+from . import resilience  # noqa: F401
 from . import profiler  # noqa: F401
 from . import runtime  # noqa: F401
 from . import test_utils  # noqa: F401

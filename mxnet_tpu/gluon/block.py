@@ -194,8 +194,16 @@ class Block:
     def save_parameters(self, filename, deduplicate=False):
         params = self._collect_params_with_prefix()
         from ..ndarray import ndarray as nd
+        from ..resilience.checkpoint import atomic_replace
 
-        nd.save(filename, {k: v._data[next(iter(v._data))] for k, v in params.items()})
+        # atomic commit (unique tmp + fsync + rename): a preemption
+        # mid-write must not corrupt the only copy of the weights —
+        # the SAME primitive the resilience checkpoints use
+        # (docs/robustness.md)
+        atomic_replace(
+            filename,
+            lambda tmp: nd.save(tmp, {k: v._data[next(iter(v._data))]
+                                      for k, v in params.items()}))
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,

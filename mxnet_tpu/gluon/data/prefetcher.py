@@ -80,6 +80,7 @@ class DevicePrefetcher:
         self._thread = None
         self._stop = threading.Event()
         self._exhausted = False
+        self._delivered = 0  # batches handed to the consumer this epoch
 
     # -- conversion -------------------------------------------------------
     def _jax_device(self):
@@ -129,6 +130,13 @@ class DevicePrefetcher:
         nbytes_box = [0]
         t0 = time.perf_counter()
         out = self._convert_leaf(batch, nbytes_box)
+        from ...resilience import chaos as _chaos
+
+        if _chaos.ENABLED and _chaos.nan_due("prefetch"):
+            # injected bad batch (MXTPU_CHAOS=nan@prefetch:N): float
+            # leaves of the Nth staged batch become NaN — the
+            # regression hook for loss-scale skip / data validation
+            out = _chaos.poison_struct(out)
         if _obs.ENABLED:
             _obs.record_h2d(nbytes_box[0], time.perf_counter() - t0,
                             self._queue.qsize())
@@ -162,6 +170,7 @@ class DevicePrefetcher:
         if self._exhausted and hasattr(self._source, "reset"):
             self._source.reset()
         self._exhausted = False
+        self._delivered = 0
         self._stop = threading.Event()
         self._queue = queue.Queue(maxsize=self._depth)
         self._thread = threading.Thread(
@@ -193,6 +202,7 @@ class DevicePrefetcher:
             _obs.DATA_PREFETCH_WAIT_SECONDS.inc(time.perf_counter() - t0)
             _obs.DATA_PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
         if kind == "ok":
+            self._delivered += 1
             return payload
         self._exhausted = True
         self.close()
@@ -202,6 +212,13 @@ class DevicePrefetcher:
 
     def next(self):
         return self.__next__()
+
+    @property
+    def cursor(self):
+        """Batches DELIVERED to the consumer this epoch — the
+        input-pipeline position a checkpoint records so a resumed epoch
+        can ``resilience.resume.skip_batches`` past consumed data."""
+        return self._delivered
 
     def __len__(self):
         return len(self._source)
@@ -384,6 +401,13 @@ class SuperstepRing:
         if self._err is not None or len(group) < self.k:
             return group, len(group)  # short tail: consumer single-steps
         return stack_batches(group), self.k
+
+    @property
+    def cursor(self):
+        """Batches delivered through the ring this epoch (stacked
+        groups count their K slots) — recorded by the checkpoint
+        manager as the data-pipeline position."""
+        return self._pf.cursor
 
     def reset(self):
         self._err = None

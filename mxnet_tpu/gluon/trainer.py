@@ -36,6 +36,7 @@ from .. import fusedstep as _fusedstep
 from .. import observability as _obs
 from .. import optimizer as opt
 from .. import random as _random
+from ..resilience import chaos as _chaos
 from ..base import MXNetError
 from ..kvstore import create as _create_kvstore
 from ..kvstore.base import KVStoreBase
@@ -229,13 +230,23 @@ class Trainer:
 
     def step(self, batch_size, ignore_stale_grad=False):
         """Scale grads by 1/batch_size, aggregate across devices, update."""
+        if _chaos.ENABLED:
+            # fault point: kill/term/raise/stall at the Nth step entry
+            _chaos.step_point("trainer")
         if _obs.introspect.PROFILING:
             # MXTPU_PROFILE window: step-bounded jax.profiler capture,
             # each covered step wrapped in a StepTraceAnnotation
             with _obs.introspect.profile_step():
-                return self._step_instrumented(batch_size,
-                                               ignore_stale_grad)
-        return self._step_instrumented(batch_size, ignore_stale_grad)
+                out = self._step_instrumented(batch_size,
+                                              ignore_stale_grad)
+        else:
+            out = self._step_instrumented(batch_size, ignore_stale_grad)
+        mgr = getattr(self, "_ckpt_manager", None)
+        if mgr is not None:
+            # async checkpoint tick: at an interval boundary this costs
+            # one copy dispatch; the write happens off-thread
+            mgr.on_step(1)
+        return out
 
     def _step_instrumented(self, batch_size, ignore_stale_grad):
         if not _obs.ENABLED:
@@ -778,22 +789,97 @@ class Trainer:
                 d._set_data(datas[0].data)
         return None
 
+    @staticmethod
+    def _natural_key(name):
+        """Digit-aware sort key: construction order, not lexicographic
+        (``dense9_`` was created before ``dense10_`` but sorts after
+        it — and Trainer param order is the LEXICOGRAPHIC sort, so two
+        models of identical structure can order the same layers
+        differently depending on where the global name counter stood)."""
+        import re as _re
+
+        return [int(t) if t.isdigit() else t
+                for t in _re.split(r"(\d+)", name)]
+
+    def _state_index_map(self, saved_names):
+        """saved-state index -> current-param index, aligned by
+        construction order (natural sort of names on each side). With
+        no saved names (format < 3) the map is identity."""
+        n = len(self._params)
+        if not saved_names or len(saved_names) != n:
+            return {i: i for i in range(n)}
+        s_order = sorted(range(n),
+                         key=lambda i: self._natural_key(saved_names[i]))
+        c_order = sorted(range(n),
+                         key=lambda i: self._natural_key(
+                             self._params[i].name))
+        return dict(zip(s_order, c_order))
+
+    @staticmethod
+    def _eager_state_to_np(st, key):
+        """Eager per-param optimizer state -> a numpy-only
+        ``{"desc", "tensors"}`` pair via the SAME structure flattener
+        the resilience checkpoints use (one walk to maintain, two
+        on-disk consumers)."""
+        import numpy as _np
+
+        from ..resilience.checkpoint import _flatten_state
+
+        if st is None:
+            return None
+        sink = {}
+        desc = _flatten_state(st, key, sink)
+        return {"desc": desc,
+                "tensors": {k: _np.asarray(v) for k, v in sink.items()}}
+
+    @staticmethod
+    def _eager_state_from_np(st):
+        from ..resilience.checkpoint import _unflatten_state
+
+        if st is None:
+            return None
+        if isinstance(st, dict) and "desc" in st:
+            return _unflatten_state(
+                st["desc"], st["tensors"],
+                wrap=lambda raw: NDArray(jnp.asarray(raw)))
+        return st  # format-1 file: a pickled state rides through
+
     def save_states(self, fname):
+        """Save optimizer state covering BOTH update paths: the fused /
+        superstep per-param pytrees (``_fused_states`` — momentum and
+        the adam/lamb bias-correction ``t`` included) AND any eager
+        ``_opt_state`` (converted to numpy), plus update counts. A
+        model trained fused, saved, loaded, and continued on EITHER
+        path keeps its momentum (tests/test_fused_step.py)."""
         import pickle
 
         import numpy as _np
 
         states = {
-            i: getattr(p, "_opt_state", None) for i, p in enumerate(self._params)
+            i: self._eager_state_to_np(getattr(p, "_opt_state", None),
+                                       f"s{i}")
+            for i, p in enumerate(self._params)
         }
+        # fused states keyed by PARAM INDEX, not global name: a fresh
+        # model built by the loading process gets new prefixed names
+        # (dense7_weight...), but position in the trainer is stable —
+        # name-keyed files silently orphaned every entry on reload
         fused_states = {
-            name: tuple(_np.asarray(leaf) for leaf in st)
-            for name, st in self._fused_states.items()
+            i: tuple(_np.asarray(leaf) for leaf in
+                     self._fused_states[p.name])
+            for i, p in enumerate(self._params)
+            if p.name in self._fused_states
         }
         with open(fname, "wb") as f:
             pickle.dump(
                 {
+                    "format": 2,
                     "states": states,
+                    # the saving trainer's param names, in ITS order:
+                    # the loader aligns indices by construction order
+                    # (lexicographic trainer order flips at the
+                    # dense9_/dense10_ digit boundary)
+                    "param_names": [p.name for p in self._params],
                     "update_counts": self._optimizer._index_update_count,
                     "num_update": self._optimizer.num_update,
                     "fused_states": fused_states,
@@ -802,19 +888,52 @@ class Trainer:
             )
 
     def load_states(self, fname):
+        """Inverse of :meth:`save_states`. Params whose state lives in
+        the restored fused store get any stale eager ``_opt_state``
+        CLEARED — the eager update path prefers an existing attribute,
+        so leaving one would silently shadow the restored momentum
+        (the pre-PR-8 bug). The next step on either path re-migrates
+        from the restored store without resetting anything."""
         import pickle
 
         with open(fname, "rb") as f:
             blob = pickle.load(f)
+        fmt = blob.get("format", 1)
+        n = len(self._params)
+        saved_n = len(blob.get("param_names", [])) or \
+            len(blob.get("states", {}))
+        if fmt >= 2 and saved_n and saved_n != n:
+            # the old name-keyed files silently skipped mismatches;
+            # silently skipping INDEX-keyed state would pair the wrong
+            # layers — refuse with a diagnosis instead
+            raise MXNetError(
+                f"load_states: file holds state for {saved_n} params, "
+                f"this trainer has {n} — the model structure differs")
+        idx_map = self._state_index_map(blob.get("param_names")) \
+            if fmt >= 2 else {i: i for i in range(n)}
+        inv_map = {ci: si for si, ci in idx_map.items()}
         for i, p in enumerate(self._params):
-            if blob["states"].get(i) is not None:
-                p._opt_state = blob["states"][i]
-        self._fused_states = {
-            name: tuple(jnp.asarray(leaf) for leaf in st)
-            for name, st in blob.get("fused_states", {}).items()
-        }
-        self._optimizer._index_update_count = blob["update_counts"]
-        self._optimizer.num_update = blob["num_update"]
+            st = blob["states"].get(inv_map.get(i, i))
+            if st is not None:
+                p._opt_state = st if fmt < 2 \
+                    else self._eager_state_from_np(st)
+            elif hasattr(p, "_opt_state"):
+                del p._opt_state
+        fused = {}
+        for key, st in blob.get("fused_states", {}).items():
+            if fmt >= 2:
+                name = self._params[idx_map.get(int(key), int(key))].name
+            else:  # format-1 files were name-keyed
+                name = key
+            fused[name] = tuple(jnp.asarray(leaf) for leaf in st)
+        self._fused_states = fused
+        # update counts are keyed by the SAVING trainer's indices: remap
+        # through the same alignment as the states, or reordered params
+        # would resume with each other's counts (skewed bias-correction)
+        self._optimizer._index_update_count = \
+            {idx_map.get(int(k), int(k)): int(v)
+             for k, v in blob["update_counts"].items()}
+        self._optimizer.num_update = int(blob["num_update"])
         self._invalidate_fused()
 
 
@@ -1091,6 +1210,17 @@ class Superstep:
         raw_y = ys.data if isinstance(ys, NDArray) else jnp.asarray(ys)
         k = int(raw_x.shape[0])
         tr = self._trainer
+        if _chaos.ENABLED:
+            # fault points (per-superstep-dispatch counter): process
+            # faults at entry; a due ``nan`` fault poisons SLOT 0 only,
+            # so "one bad microbatch skips one iteration" is testable
+            _chaos.step_point("superstep")
+            # dtype check FIRST: nan_due consumes (and counts) a
+            # one-shot fault — firing it for an unpoisonable int batch
+            # would log an injection that never happened
+            if jnp.issubdtype(raw_x.dtype, jnp.floating) and \
+                    _chaos.nan_due("superstep"):
+                raw_x = raw_x.at[0].set(jnp.nan)
         if self._plan is None and any(
                 p._data is None
                 for _, p in self._block.collect_params().items()):
@@ -1210,6 +1340,11 @@ class Superstep:
             _obs.record_superstep_series(losses, gnorms, it_ovfs)
             if plan["amp"]:
                 _obs.record_amp_lazy(scaler._scale_arr, new_ovf)
+        mgr = getattr(tr, "_ckpt_manager", None)
+        if mgr is not None:
+            # one superstep = K training steps for checkpoint cadence
+            # (the fallback path ticks per-step through tr.step instead)
+            mgr.on_step(k)
         return NDArray(losses)
 
     def _dispatch(self, plan, args, k):

@@ -17,7 +17,9 @@ wire-speed AllReduce, unlike the round-1 allgather+host-sum fallback.
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
 
 import numpy as _np
 
@@ -25,11 +27,62 @@ import jax
 import jax.numpy as jnp
 
 from .. import observability as _obs
+from ..base import MXNetError, getenv
 from ..ndarray.ndarray import NDArray
 from .base import register_kvstore
 from .local import KVStoreLocal, _nd_nbytes
 
+_logger = logging.getLogger("mxnet_tpu.kvstore.dist")
+
 _REDUCE = {"mesh": None, "fn": None}
+
+
+def _barrier_timeout_s() -> float:
+    """``MXTPU_BARRIER_TIMEOUT_S`` (default 600): how long one barrier
+    entry may block before it fails LOUDLY instead of hanging the
+    worker forever (a preempted peer never arrives — the reference's
+    ps-lite barrier had the same indefinite-wait failure mode). 0
+    disables the watchdog."""
+    return float(getenv("MXTPU_BARRIER_TIMEOUT_S", 600.0, dtype=float))
+
+
+class CollectiveTimeoutError(MXNetError):
+    """A collective/barrier watchdog expired: a peer is gone. Never
+    retried — the abandoned watchdog thread may still be blocked inside
+    the original sync, and re-entering the same tag could join the
+    barrier twice once the peer recovers."""
+
+
+def _call_with_timeout(fn, timeout, desc):
+    """Run ``fn`` on a worker thread and join with ``timeout``; a hang
+    raises CollectiveTimeoutError with a diagnosis instead of blocking
+    forever (the stuck thread is daemonized and abandoned — the caller
+    is expected to crash out, checkpoint + flight recorder in tow)."""
+    if not timeout or timeout <= 0:
+        return fn()
+    box = {}
+
+    def run():
+        try:
+            box["out"] = fn()
+        except BaseException as e:  # surfaced on the caller thread
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="mxtpu-collective-watchdog")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        _logger.error(
+            "%s timed out after %.0fs — a peer process is gone or the "
+            "coordination service is unreachable; failing loudly "
+            "instead of hanging (MXTPU_BARRIER_TIMEOUT_S)", desc, timeout)
+        raise CollectiveTimeoutError(
+            f"{desc} timed out after {timeout:.0f}s "
+            f"(rank {jax.process_index()}/{jax.process_count()})")
+    if "err" in box:
+        raise box["err"]
+    return box.get("out")
 
 
 def _reduce_mesh():
@@ -52,6 +105,13 @@ def _global_allreduce(raw):
     shard of a (num_processes, ...) global array partitioned on ``dp``;
     ``sum(axis=0)`` with a fully-replicated out-sharding is the reduce.
     """
+    from ..resilience import chaos as _chaos
+
+    if _chaos.ENABLED:
+        # one-shot injected collective failure (MXTPU_CHAOS=collective):
+        # surfaces loudly from the pushpull — the regression hook for
+        # "a dead collective fails, it does not hang"
+        _chaos.collective_point("collective")
     if jax.process_count() == 1:
         return raw
     if _obs.ENABLED:
@@ -147,13 +207,39 @@ class KVStoreDistTPU(KVStoreLocal):
         return jax.process_count() == 1
 
     def barrier(self):
+        """Cross-process barrier with a loud watchdog timeout
+        (``MXTPU_BARRIER_TIMEOUT_S``) and retry-with-backoff on
+        transient failure — a preempted peer turns into a diagnosable
+        crash (checkpoint + flight bundle fire on the way down), never
+        an indefinite hang."""
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
+            from .. import runtime
+            from ..resilience import chaos as _chaos
+
             if _obs.ENABLED:
                 _obs.KV_BARRIER_TOTAL.inc()
-            multihost_utils.sync_global_devices(
-                f"mxtpu_kv_barrier_{self._barrier_count}")
+            tag = f"mxtpu_kv_barrier_{self._barrier_count}"
+            timeout = _barrier_timeout_s()
+
+            def attempt():
+                if _chaos.ENABLED:
+                    _chaos.collective_point("barrier")
+                _call_with_timeout(
+                    lambda: multihost_utils.sync_global_devices(tag),
+                    timeout, f"kvstore barrier {tag!r}")
+
+            # retries cover failures raised BEFORE/WITHOUT completing
+            # the sync (injected faults, transient transport errors);
+            # a watchdog TIMEOUT surfaces immediately — the peers are
+            # gone, and waiting retries x timeout would turn "fail
+            # loudly" back into a multi-stage hang
+            runtime.retry_with_backoff(
+                attempt,
+                attempts=int(getenv("MXTPU_BARRIER_RETRIES", 3, dtype=int)),
+                base_delay=0.5, desc=f"kvstore barrier {tag!r}",
+                no_retry=(CollectiveTimeoutError,), logger=_logger)
             self._barrier_count += 1
 
 
@@ -172,9 +258,18 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
         num_processes = int(os.environ["MXTPU_NUM_PROCESSES"])
     if process_id is None and "MXTPU_PROCESS_ID" in os.environ:
         process_id = int(os.environ["MXTPU_PROCESS_ID"])
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    from .. import runtime
+
+    # collective SETUP is the flakiest moment of a pod bring-up (the
+    # coordinator may still be binding while workers race in): retry
+    # with backoff instead of dying on the first connection refusal
+    runtime.retry_with_backoff(
+        lambda: jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        ),
+        attempts=int(getenv("MXTPU_DIST_INIT_ATTEMPTS", 3, dtype=int)),
+        base_delay=2.0, desc="jax.distributed.initialize",
+        logger=_logger)

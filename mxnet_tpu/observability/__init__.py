@@ -232,6 +232,37 @@ AMP_OVERFLOW_TOTAL = _REGISTRY.gauge(
     "scaler was created — monotonic; a gauge, not a counter, so the "
     "fused step can record the in-graph total as a lazy device scalar")
 
+# -- resilience: async checkpointing + chaos (mxnet_tpu/resilience) --------
+
+CHECKPOINT_TOTAL = _REGISTRY.counter(
+    "mxtpu_checkpoint_total",
+    "committed training checkpoints, by reason "
+    "(interval / manual / sigterm)")
+CHECKPOINT_SECONDS = _REGISTRY.histogram(
+    "mxtpu_checkpoint_seconds",
+    "wall time of one checkpoint serialize+write+commit (runs on the "
+    "background writer thread — NOT training-loop time)")
+CHECKPOINT_BYTES_TOTAL = _REGISTRY.counter(
+    "mxtpu_checkpoint_bytes_total",
+    "payload bytes committed to checkpoint storage")
+CHECKPOINT_LAST_STEP = _REGISTRY.gauge(
+    "mxtpu_checkpoint_last_step",
+    "training step of the most recently committed checkpoint (the "
+    "recovery point a preemption right now would resume from)")
+CHECKPOINT_ERRORS_TOTAL = _REGISTRY.counter(
+    "mxtpu_checkpoint_errors_total",
+    "failed checkpoint snapshots/writes (training continues; the "
+    "recovery point goes stale — alert on this)")
+CHECKPOINT_DROPPED_TOTAL = _REGISTRY.counter(
+    "mxtpu_checkpoint_dropped_total",
+    "queued snapshots replaced by a newer one before the writer got to "
+    "them (latest-wins backpressure: storage slower than the cadence)")
+
+CHAOS_INJECTIONS_TOTAL = _REGISTRY.counter(
+    "mxtpu_chaos_injections_total",
+    "faults injected by the chaos harness (MXTPU_CHAOS), by kind and "
+    "site — nonzero outside a test run means someone left chaos armed")
+
 # -- executable introspection (MXTPU_INTROSPECT; observability/introspect) --
 
 EXEC_FLOPS = _REGISTRY.gauge(

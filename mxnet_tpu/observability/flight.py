@@ -50,6 +50,44 @@ _STATE = {
 _IN_FLIGHT: dict = {}  # site -> depth (currently executing dispatches)
 _IN_FLIGHT_LOCK = threading.Lock()
 
+#: pre-dump hooks: callables run BEFORE the bundle is written when a
+#: hooked signal fires (and, for hooks registered with
+#: ``signals_only=False``, before an exception bundle too). This is the
+#: deterministic ordering seam for the resilience layer: the final
+#: checkpoint registers here, so "checkpoint first, flight bundle
+#: second" holds no matter which handler was installed first (the other
+#: install order reaches the same sequence through handler chaining +
+#: the checkpoint's own once-per-death flag). Hooks are best-effort —
+#: one raising must not cost the bundle or the re-raise.
+_PRE_DUMP_HOOKS: list = []  # (fn, signals_only)
+
+
+def register_pre_dump(fn, signals_only=True):
+    """Run ``fn()`` before the crash bundle is written (idempotent per
+    fn). ``signals_only``: skip it for plain unhandled exceptions."""
+    for f, _ in _PRE_DUMP_HOOKS:
+        if f is fn:
+            return
+    _PRE_DUMP_HOOKS.append((fn, bool(signals_only)))
+
+
+def unregister_pre_dump(fn):
+    _PRE_DUMP_HOOKS[:] = [(f, s) for f, s in _PRE_DUMP_HOOKS if f is not fn]
+
+
+def _run_pre_dump(from_signal):
+    for fn, signals_only in list(_PRE_DUMP_HOOKS):
+        if signals_only and not from_signal:
+            continue
+        try:
+            fn()
+        except Exception as e:  # a hook must never mask the crash
+            try:
+                _logger.error("flight pre-dump hook failed: %s: %s",
+                              type(e).__name__, e)
+            except Exception:
+                pass
+
 
 def installed() -> bool:
     return INSTALLED
@@ -210,6 +248,7 @@ def dump(reason="manual", path=None) -> str | None:
 def _excepthook(exc_type, exc, tb):
     if not _STATE["dumped"]:
         _STATE["dumped"] = True
+        _run_pre_dump(from_signal=False)
         dump(reason=f"exception: {exc_type.__name__}: {exc}"[:300])
     prev = _STATE["prev_excepthook"] or sys.__excepthook__
     prev(exc_type, exc, tb)
@@ -218,6 +257,9 @@ def _excepthook(exc_type, exc, tb):
 def _signal_handler(signum, frame):
     if not _STATE["dumped"]:
         _STATE["dumped"] = True
+        # resilience ordering contract: the final checkpoint (a pre-dump
+        # hook) commits BEFORE the flight bundle is written
+        _run_pre_dump(from_signal=True)
         try:
             name = signal.Signals(signum).name
         except ValueError:

@@ -1,0 +1,42 @@
+"""``mxnet_tpu.resilience`` — fault-tolerant training.
+
+Three legs (docs/robustness.md):
+
+- :mod:`.checkpoint` — async checkpointing (``MXTPU_CHECKPOINT``):
+  complete-state snapshots (params, fused/eager optimizer state, AMP
+  scaler, update counts, RNG key, data cursor) written by a background
+  thread with atomic rename-commit, manifest + checksums, retention,
+  and a SIGTERM final save chained before the crash flight recorder.
+- :mod:`.resume` — preemption-tolerant elastic resume: restore onto
+  the CURRENT topology (bit-exact on an unchanged one; resharded via
+  ``parallel/spmd.py`` when the device count changed).
+- :mod:`.chaos` — deterministic fault injection (``MXTPU_CHAOS``):
+  kill/term/raise-at-step, NaN-poisoned batch, one-shot collective
+  failure, slow-host stall — zero-cost (one module-bool read, zero
+  dispatches) when disabled, so robustness claims stay
+  regression-testable.
+"""
+
+from __future__ import annotations
+
+from . import chaos  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import resume  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointManager,
+    latest_checkpoint,
+    maybe_checkpointing,
+    verify,
+    write_checkpoint,
+)
+from .resume import (  # noqa: F401
+    ResumeReport,
+    list_checkpoints,
+    load_checkpoint,
+    save_spmd_checkpoint,
+    skip_batches,
+)
+
+# MXTPU_CHAOS: faults arm at import (opt-in via env only — without the
+# var this is one getenv and nothing else)
+chaos.maybe_configure()

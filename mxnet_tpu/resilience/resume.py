@@ -1,0 +1,355 @@
+"""Elastic resume: restore a checkpoint onto the CURRENT topology.
+
+The restart after a preemption rarely looks like the process that died:
+a smaller pool, a different device count, sometimes a single debug host
+reading a pod checkpoint. This module restores a
+:mod:`~mxnet_tpu.resilience.checkpoint` directory onto whatever is
+running NOW:
+
+- **Trainer checkpoints** (the Gluon loop): params, fused/eager
+  optimizer state, AMP loss-scaler counters, update counts and the RNG
+  key land back in the net + Trainer — bit-exact on an unchanged
+  topology (regression-pinned), and device-count independent by
+  construction (every tensor is replicated in this mode).
+- **SPMD checkpoints** (``SPMDTrainStep`` shard sets): each tensor is
+  reassembled from whatever shard files cover it and re-sharded under
+  the step's CURRENT mesh/spec layout (``parallel/spmd.py``
+  ``spmd_load_states``) — a 2-device-sharded save restores onto 1
+  device, or onto a different dp/tp split, without any host ever
+  materializing more than its own shards.
+
+LR-schedule continuity comes from the restored update counts: the
+scheduler is a pure function of ``num_update``, so the first resumed
+step samples exactly the lr the dead process would have used.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as _np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from . import checkpoint as _ckpt
+
+_logger = logging.getLogger("mxnet_tpu.resume")
+
+
+class ResumeReport:
+    """What a restore actually did: ``step``/``cursor`` to continue
+    from, the saved vs current world shapes, and whether the restore
+    was elastic (topology changed)."""
+
+    def __init__(self, path, step, cursor, saved_world, kind):
+        self.path = path
+        self.step = step
+        self.cursor = cursor
+        self.saved_world = saved_world or {}
+        self.kind = kind
+        try:
+            self.current_world = {"backend": jax.default_backend(),
+                                  "process_count": jax.process_count(),
+                                  "device_count": jax.device_count()}
+        except Exception:  # pragma: no cover
+            self.current_world = {}
+        self.elastic = bool(
+            self.saved_world
+            and self.saved_world.get("device_count") is not None
+            and self.saved_world.get("device_count")
+            != self.current_world.get("device_count"))
+
+    def __repr__(self):
+        return (f"ResumeReport(step={self.step}, kind={self.kind!r}, "
+                f"elastic={self.elastic}, "
+                f"saved_devices={self.saved_world.get('device_count')}, "
+                f"current_devices={self.current_world.get('device_count')})")
+
+
+def _param_keys(net, trainer):
+    """``checkpoint key -> Parameter`` map: structural names from the
+    net (the save-time scheme) plus global names as fallback."""
+    by_key = {}
+    if net is not None:
+        for sname, p in net._collect_params_with_prefix().items():
+            by_key.setdefault(sname, p)
+        for _, p in net.collect_params().items():
+            by_key.setdefault(p.name, p)
+    if trainer is not None:
+        for p in trainer._params:
+            by_key.setdefault(p.name, p)
+    return by_key
+
+
+def _restore_params(tensors, net, trainer):
+    from ..ndarray.ndarray import NDArray
+
+    by_key = _param_keys(net, trainer)
+    missing, matched = [], 0
+    for key, host in tensors.items():
+        if not key.startswith("param::"):
+            continue
+        name = key[len("param::"):]
+        p = by_key.get(name)
+        if p is None:
+            missing.append(name)
+            continue
+        matched += 1
+        p._load_init(NDArray(jnp.asarray(host)))
+    if missing and matched == 0:
+        # structural checkpoint keys ("0.weight") only resolve through
+        # the net — restoring NOTHING while returning success would let
+        # the caller train on from fresh state believing they resumed
+        raise MXNetError(
+            f"resume: none of the {len(missing)} checkpoint params "
+            f"match the current model (first: {missing[:3]}). "
+            "Checkpoints saved with net= use structural names — pass "
+            "the same net= to load_checkpoint (or the model differs).")
+    if missing:
+        _logger.warning("resume: %d checkpoint params have no match in "
+                        "the current model (first: %s)", len(missing),
+                        missing[:3])
+    return by_key
+
+
+def _restore_trainer(manifest, tensors, trainer, net=None):
+    from ..ndarray.ndarray import NDArray
+
+    extras = manifest.get("extras", {})
+    o = trainer._optimizer
+    o._index_update_count = {int(k): int(v) for k, v in
+                             extras.get("update_counts", {}).items()}
+    o.num_update = int(extras.get("num_update", o.num_update))
+    opt_kind = extras.get("opt_kind", {})
+    by_key = _param_keys(net, trainer)
+    key_of = {id(p): k for k, p in reversed(list(by_key.items()))}
+    fused = {}
+    kinds_matched = 0
+    for p in trainer._params:
+        key = key_of.get(id(p), p.name)
+        kind = opt_kind.get(key) or opt_kind.get(p.name)
+        if kind is not None:
+            kinds_matched += 1
+        if kind == "fused":
+            kk = key if f"fused::{key}::0" in tensors else p.name
+            leaves = []
+            i = 0
+            while f"fused::{kk}::{i}" in tensors:
+                leaves.append(jnp.asarray(tensors[f"fused::{kk}::{i}"]))
+                i += 1
+            fused[p.name] = tuple(leaves)
+            # the fused pytree is now the single owner; a stale eager
+            # state would shadow it on the per-param path
+            if hasattr(p, "_opt_state"):
+                del p._opt_state
+        elif kind == "eager":
+            desc = extras.get("eager_structs", {}).get(key) \
+                or extras.get("eager_structs", {}).get(p.name)
+            p._opt_state = _ckpt._unflatten_state(
+                desc, tensors,
+                wrap=lambda raw: NDArray(jnp.asarray(raw)))
+        else:
+            if hasattr(p, "_opt_state"):
+                del p._opt_state
+    if opt_kind and trainer._params and kinds_matched == 0:
+        raise MXNetError(
+            "resume: the checkpoint carries optimizer state but none "
+            "of its keys match this trainer's params — restoring would "
+            "silently RESET momentum/adam-t. Pass the net= the "
+            "checkpoint was saved with (structural names), or check "
+            "the model matches.")
+    if kinds_matched < len(opt_kind):
+        # a partial mismatch resets momentum for the unmatched params
+        # only — diverges quietly from the uninterrupted run, so say so
+        _logger.warning(
+            "resume: %d of %d optimizer-state entries in the "
+            "checkpoint matched no param — those params restart with "
+            "FRESH optimizer state (renamed/reordered blocks?)",
+            len(opt_kind) - kinds_matched, len(opt_kind))
+    trainer._fused_states = fused
+    trainer._invalidate_fused()
+    scaler_meta = extras.get("scaler")
+    if scaler_meta is not None:
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            from ..amp import LossScaler
+
+            scaler = LossScaler(scale_factor=scaler_meta["factor"],
+                                scale_window=scaler_meta["window"])
+            trainer._amp_loss_scaler = scaler
+        scaler._factor = float(scaler_meta["factor"])
+        scaler._window = int(scaler_meta["window"])
+        scaler._scale_arr = jnp.asarray(tensors["scaler::scale"])
+        scaler._unskipped_arr = jnp.asarray(tensors["scaler::unskipped"])
+        scaler._overflow_total_arr = jnp.asarray(
+            tensors["scaler::overflow_total"])
+
+
+def _restore_rng(tensors):
+    if "rng::key" not in tensors:
+        return
+    from .. import random as _random
+
+    _random._S.key = jnp.asarray(_np.asarray(tensors["rng::key"]))
+
+
+def load_checkpoint(path, net=None, trainer=None, spmd_step=None,
+                    verify_checksums=True, restore_rng=True):
+    """Restore ``path`` (a checkpoint root or one ``step_*`` dir) onto
+    the current process. Pass ``net``/``trainer`` for a Gluon loop, or
+    ``spmd_step`` (an initialized-or-not ``SPMDTrainStep``) for a
+    sharded SPMD checkpoint — resharding onto the step's current mesh,
+    whatever the device count was at save time. Returns a
+    :class:`ResumeReport`."""
+    manifest, tensors = _ckpt.read_checkpoint(
+        path, verify_checksums=verify_checksums)
+    extras = manifest.get("extras", {})
+    kind = extras.get("kind", "trainer")
+    if spmd_step is not None:
+        if kind != "spmd":
+            raise MXNetError(
+                f"{manifest['_path']}: checkpoint kind is {kind!r}, not a "
+                "sharded SPMD checkpoint — pass net/trainer instead")
+        from ..parallel.spmd import spmd_load_states
+
+        prefix = os.path.join(manifest["_path"],
+                              extras.get("spmd_prefix", "spmd"))
+        spmd_load_states(spmd_step, prefix)
+        # elastic detection for the SPMD kind compares MESH sizes (the
+        # process-global device count says nothing about the sharding)
+        saved_mesh = extras.get("mesh_devices")
+        cur_mesh = (spmd_step.mesh.devices.size
+                    if spmd_step.mesh is not None else 1)
+        world = dict(manifest.get("world") or {})
+        if saved_mesh is not None:
+            world["device_count"] = saved_mesh
+        report = ResumeReport(manifest["_path"], extras.get("step"),
+                              extras.get("cursor"), world, kind)
+        report.current_world["device_count"] = cur_mesh
+        report.elastic = saved_mesh is not None and saved_mesh != cur_mesh
+        if report.elastic:
+            _logger.warning(
+                "resume: ELASTIC restore — checkpoint sharded over %s "
+                "devices, restored onto %s (%s)", saved_mesh, cur_mesh,
+                report.path)
+        _logger.info("resume: restored %s", report)
+        return report
+    else:
+        if kind != "trainer":
+            raise MXNetError(
+                f"{manifest['_path']}: checkpoint kind is {kind!r} — "
+                "pass spmd_step= to restore it")
+        _restore_params(tensors, net, trainer)
+        if trainer is not None:
+            _restore_trainer(manifest, tensors, trainer, net=net)
+        if restore_rng:
+            _restore_rng(tensors)
+    report = ResumeReport(manifest["_path"], extras.get("step"),
+                          extras.get("cursor"), manifest.get("world"),
+                          kind)
+    if report.elastic:
+        _logger.warning(
+            "resume: ELASTIC restore — checkpoint was written on %s "
+            "devices, restoring onto %s (%s)",
+            report.saved_world.get("device_count"),
+            report.current_world.get("device_count"), report.path)
+    _logger.info("resume: restored %s", report)
+    return report
+
+
+def save_spmd_checkpoint(directory, spmd_step, step, reason="manual",
+                         barrier=None):
+    """Write an ``SPMDTrainStep``'s sharded state as a committed
+    checkpoint. Every process calls this with ``directory`` on a
+    SHARED filesystem; each rank writes only its addressable shards
+    (``spmd.shard<rank>.npz``) into a per-step staging dir, then —
+    after ``barrier()`` (pass ``kvstore.barrier`` on a pod; required
+    when ``process_count > 1``) — **rank 0 alone** manifests all shard
+    files with checksums and performs the atomic rename-commit. A
+    single process stages + commits directly. Returns the committed
+    path on rank 0 (and on a single process), None on other ranks."""
+    import jax as _jax
+
+    if spmd_step._state is None:
+        raise MXNetError("save_spmd_checkpoint: run a step (or "
+                         "init_state()) first")
+    from ..parallel.spmd import spmd_save_states
+
+    nproc = _jax.process_count()
+    rank = _jax.process_index()
+    extras = {"kind": "spmd", "spmd_prefix": "spmd",
+              "step": int(step),
+              "mesh_devices": (spmd_step.mesh.devices.size
+                               if spmd_step.mesh is not None else 1),
+              "process_count": nproc,
+              "tensor_names": list(spmd_step._names or [])}
+    if nproc == 1:
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="spmd-ckpt-") as scratch:
+            fname = spmd_save_states(spmd_step,
+                                     os.path.join(scratch, "spmd"))
+            return _ckpt.write_checkpoint(
+                directory, {}, extras, step, reason=reason,
+                extra_files={os.path.basename(fname): fname})
+    # multi-process: stage every rank's shard file in ONE shared dir —
+    # a per-rank tempdir would vanish with its rank, and per-rank
+    # commits would clobber each other leaving a manifest that lists
+    # only the last committer's shard
+    if barrier is None:
+        raise MXNetError(
+            "save_spmd_checkpoint on a multi-process mesh needs a "
+            "barrier callable (pass kvstore.barrier): rank 0 must not "
+            "commit before every rank's shard file is staged")
+    staging = os.path.join(str(directory),
+                           f".shards-{_ckpt._step_dirname(step)}")
+    os.makedirs(staging, exist_ok=True)
+    fname = spmd_save_states(spmd_step, os.path.join(staging, "spmd"))
+    barrier()  # every rank's shard is on the shared FS past this point
+    out = None
+    if rank == 0:
+        # manifest EXACTLY this run's expected shard set — a bare glob
+        # would sweep stale shards from a crashed (or differently
+        # sized) earlier run of the same step into the commit with
+        # perfectly valid checksums
+        shards = {}
+        for r in range(nproc):
+            p = os.path.join(staging, f"spmd.shard{r}.npz")
+            if not os.path.exists(p):
+                raise MXNetError(
+                    f"save_spmd_checkpoint: rank {r}'s shard file is "
+                    f"missing from {staging} after the barrier — "
+                    "shared-filesystem visibility problem?")
+            shards[os.path.basename(p)] = p
+        out = _ckpt.write_checkpoint(directory, {}, extras, step,
+                                     reason=reason, extra_files=shards)
+        import shutil
+
+        shutil.rmtree(staging, ignore_errors=True)
+    barrier()  # nobody proceeds (or exits) before the commit landed
+    return out
+
+
+def skip_batches(source, n):
+    """Fast-forward an iterable ``n`` batches (the checkpoint's data
+    ``cursor``) so a resumed epoch does not re-train consumed data.
+    Returns an iterator positioned after batch ``n``; sources with
+    random-access semantics should seek natively instead."""
+    it = iter(source)
+    for i in range(int(n)):
+        try:
+            next(it)
+        except StopIteration:
+            _logger.warning("resume: cursor %d past the end of the "
+                            "source (epoch boundary?) — %d skipped", n, i)
+            break
+    return it
+
+
+def list_checkpoints(directory):
+    """Committed ``(step, path)`` pairs under a checkpoint root."""
+    return [(s, os.path.join(directory, _ckpt._step_dirname(s)))
+            for s in _ckpt._committed_steps(directory)]

@@ -3,9 +3,58 @@
 
 from __future__ import annotations
 
+import logging
 import os
+import time
 
 import jax
+
+_logger = logging.getLogger("mxnet_tpu.runtime")
+
+
+# ---------------------------------------------------------------------------
+# transient-failure retry (the PR-5 bench.py backend-init pattern, now a
+# shared primitive: backend bring-up, collective setup and the kvstore
+# barrier all retry through here instead of each growing its own loop)
+# ---------------------------------------------------------------------------
+
+def retry_with_backoff(fn, attempts=3, base_delay=2.0, desc="operation",
+                       retry_on=(Exception,), no_retry=(), logger=None):
+    """Call ``fn()`` up to ``attempts`` times with linear backoff
+    (``base_delay * attempt`` seconds between tries), logging each
+    failure LOUDLY. Re-raises the last exception when every attempt
+    fails — a transient infra hiccup retries, a real failure still
+    surfaces (never silently swallowed). Exception types in
+    ``no_retry`` surface IMMEDIATELY (e.g. a barrier watchdog timeout:
+    the peers are gone, and re-entering the same barrier tag after
+    abandoning a still-blocked watchdog thread could double-join)."""
+    log = logger or _logger
+    attempts = max(1, int(attempts))
+    last = None
+    for i in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 - retry loop by design
+            if no_retry and isinstance(e, no_retry):
+                raise
+            last = e
+            log.warning("%s attempt %d/%d failed: %s: %s", desc, i,
+                        attempts, type(e).__name__, str(e)[:300])
+            if i < attempts:
+                time.sleep(base_delay * i)
+    raise last
+
+
+def init_backend(attempts=3):
+    """Resolve the JAX backend with retry + backoff. Returns
+    ``(backend_name, None)`` or ``(None, error_string)`` — one
+    transient 'Unable to initialize backend' at startup must not erase
+    a run (VERDICT r5; formerly private to bench.py)."""
+    try:
+        return retry_with_backoff(jax.default_backend, attempts=attempts,
+                                  desc="backend init"), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"[:300]
 
 # ---------------------------------------------------------------------------
 # persistent compilation cache (MXTPU_COMPILE_CACHE)

@@ -19,11 +19,50 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 LAUNCH = os.path.join(ROOT, "tools", "launch.py")
 WORKER = os.path.join(ROOT, "tests", "distributed", "dist_worker.py")
 
+#: HARD per-test wall budget (seconds) for every launcher subprocess —
+#: a hung PJRT coordination handshake or dead-peer barrier must fail
+#: THIS test loudly (with captured output) instead of burning the whole
+#: tier-1 suite budget waiting on a 300-900 s default timeout. 0
+#: disables the cap (soak runs).
+DIST_TEST_TIMEOUT_S = int(os.environ.get("MXTPU_DIST_TEST_TIMEOUT", "120"))
+
 
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _tail(out, n=4000):
+    if out is None:
+        return "<none captured>"
+    if isinstance(out, bytes):
+        out = out.decode(errors="replace")
+    return out[-n:]
+
+
+def _run_capped(cmd, env, timeout, what, cap=True):
+    """subprocess.run with the hard cap + a diagnostic-rich failure:
+    on timeout the test FAILS (not errors out of budget) with the
+    partial stdout/stderr attached — 'which rank hung and on what' is
+    readable straight from the pytest report. ``cap=False`` keeps the
+    caller's full budget (the single-process REFERENCE workers pass
+    today and may legitimately need their long cold-compile timeouts
+    on a loaded host — only the multiprocess launcher runs, the known
+    hang risk, get the hard cap)."""
+    t = timeout if (DIST_TEST_TIMEOUT_S <= 0 or not cap) \
+        else min(timeout, DIST_TEST_TIMEOUT_S)
+    try:
+        return subprocess.run(cmd, env=env, capture_output=True,
+                              text=True, timeout=t)
+    except subprocess.TimeoutExpired as e:
+        pytest.fail(
+            f"{what} exceeded the hard {t}s budget "
+            f"(MXTPU_DIST_TEST_TIMEOUT={DIST_TEST_TIMEOUT_S}) — a "
+            "worker is hung (PJRT coordination / collective / barrier "
+            "never completed) rather than failing.\n"
+            f"stdout tail:\n{_tail(e.stdout)}\n"
+            f"stderr tail:\n{_tail(e.stderr)}", pytrace=False)
 
 
 def _base_env(ndev=None, **extra):
@@ -44,12 +83,12 @@ def _base_env(ndev=None, **extra):
 
 
 def _launch(worker, nworkers, env=None, timeout=300):
-    return subprocess.run(
+    return _run_capped(
         [sys.executable, LAUNCH, "-n", str(nworkers),
          "--coordinator", f"127.0.0.1:{_free_port()}",
          sys.executable, worker],
-        env=env if env is not None else _base_env(),
-        capture_output=True, text=True, timeout=timeout)
+        env if env is not None else _base_env(), timeout,
+        f"launcher run of {os.path.basename(worker)} x{nworkers}")
 
 
 def _run_launcher(nworkers, timeout=300):
@@ -112,8 +151,8 @@ def test_spmd_step_multiprocess_multidevice(nprocs, ndev):
     mesh (8 devices total, dp=4 x tp=2) and assert the final loss equals
     a 1-process 8-device run of the same program."""
     # reference: single process, 8 local devices
-    ref = subprocess.run([sys.executable, SPMD_WORKER], env=_base_env(8),
-                         capture_output=True, text=True, timeout=300)
+    ref = _run_capped([sys.executable, SPMD_WORKER], _base_env(8), 300,
+                      "spmd reference worker (1 proc x 8 dev)", cap=False)
     assert ref.returncode == 0, ref.stderr[-3000:]
     import re
 
@@ -145,8 +184,8 @@ def test_pp_ep_multiprocess_multidevice(nprocs, ndev):
     N-process x M-device global mesh as on 1 process x 8 devices."""
     import re
 
-    ref = subprocess.run([sys.executable, PP_EP_WORKER], env=_base_env(8),
-                         capture_output=True, text=True, timeout=600)
+    ref = _run_capped([sys.executable, PP_EP_WORKER], _base_env(8), 600,
+                      "pp/ep reference worker (1 proc x 8 dev)", cap=False)
     assert ref.returncode == 0, ref.stderr[-3000:]
     m = re.search(r"PP_EP_OK rank=0/1 (.*)", ref.stdout)
     assert m, (f"reference worker printed no OK line\nstdout:\n"

@@ -703,3 +703,83 @@ def test_eager_update_scale_emits_trace_event():
     finally:
         obs.set_enabled(prev)
         obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# load_parameters after convert_model (PR 8 satellite): the saved mixed
+# dtype set (fp32-pinned norm layers + low-precision compute weights)
+# must restore to exactly the same dtypes, and the fused plan must keep
+# working across the reload
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_load_parameters_after_convert_model(tmp_path, dtype):
+    amp.init(dtype)
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, in_units=8))
+        net.add(nn.BatchNorm(in_channels=8))
+        net.add(nn.Dense(3, in_units=8))
+        net.initialize(init=mx.initializer.Xavier())
+        amp.convert_model(net)
+        net.hybridize()
+        return net
+
+    net = build()
+    X = mx.nd.ones((4, 8)).astype(dtype)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9,
+                        "multi_precision": True}, kvstore=None)
+    if dtype == "float16":
+        amp.init_trainer(tr)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(2):  # real training: running stats move, plan builds
+        with autograd.record():
+            l = loss_fn(net(X), mx.nd.zeros((4,)))
+            if dtype == "float16":
+                with amp.scale_loss(l, tr) as sl:
+                    sl.backward()
+        if dtype != "float16":
+            l.backward()
+        tr.step(4)
+    assert isinstance(tr._fused, dict)  # fast path active pre-save
+    fname = str(tmp_path / "mixed.params")
+    net.save_parameters(fname)
+
+    # restore into a FRESH converted net: every dtype must come back
+    # exactly (low-precision compute weights, fp32 norm params + stats)
+    net2 = build()
+    net2.load_parameters(fname)
+    p1 = net._collect_params_with_prefix()
+    p2 = net2._collect_params_with_prefix()
+    saw_low = saw_f32 = False
+    for name in p1:
+        d1, d2 = p1[name].data(), p2[name].data()
+        assert str(d2.dtype) == str(d1.dtype), \
+            f"{name}: saved {d1.dtype} restored as {d2.dtype}"
+        np.testing.assert_array_equal(
+            np.asarray(d1.data.astype("float32")),
+            np.asarray(d2.data.astype("float32")))
+        if str(d1.dtype) == dtype:
+            saw_low = True
+        if str(d1.dtype) == "float32":
+            saw_f32 = True
+    assert saw_low and saw_f32  # the mix survived, not a blanket cast
+
+    # and reloading into the LIVE net must not break the fused plan:
+    # _load_init mutates the existing handles in place, so the cached
+    # plan stays valid and the next step still takes the fast path
+    plan_before = tr._fused
+    net.load_parameters(fname)
+    with autograd.record():
+        l = loss_fn(net(X), mx.nd.zeros((4,)))
+        if dtype == "float16":
+            with amp.scale_loss(l, tr) as sl:
+                sl.backward()
+    if dtype != "float16":
+        l.backward()
+    tr.step(4)
+    assert isinstance(tr._fused, dict)
+    assert tr._fused is plan_before  # not invalidated by the reload

@@ -31,6 +31,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR4_OUT"] = str(tmp_path / "BENCH_pr4.json")
     env["BENCH_PR5_OUT"] = str(tmp_path / "BENCH_pr5.json")
     env["BENCH_PR6_OUT"] = str(tmp_path / "BENCH_pr6.json")
+    env["BENCH_PR8_OUT"] = str(tmp_path / "BENCH_pr8.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -57,6 +58,30 @@ def _rerun_cache_probe(env):
     recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
             if ln.startswith("{")]
     return _warm_cache_rec(recs), res
+
+
+def _ckpt_rec(recs):
+    ck = [r for r in recs
+          if r["metric"].startswith("checkpoint_async_superstep")]
+    return ck[0] if ck else None
+
+
+def _rerun_checkpoint_probe(env):
+    """Checkpoint overhead > 5% during the full run is almost always
+    suite-wide host pressure (every test shares this core with the
+    background writer), not a regression — re-run JUST the checkpoint
+    scenario in a clean subprocess once before failing (the same
+    policy as the warm-cache probe above)."""
+    env2 = dict(env)
+    env2["BENCH_ONLY"] = "checkpoint"
+    env2["BENCH_PR8_OUT"] = env["BENCH_PR8_OUT"] + ".retry"
+    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
+    res = subprocess.run(
+        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
+        env=env2, capture_output=True, text=True, timeout=600)
+    recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    return _ckpt_rec(recs), res
 
 
 def test_bench_emits_driver_contract(tmp_path):
@@ -96,6 +121,19 @@ def test_bench_emits_driver_contract(tmp_path):
     pr6 = json.load(open(tmp_path / "BENCH_pr6.json"))
     assert pr6["scenario"] == "superstep" \
         and pr6["dispatch_reduction"] >= 4, pr6
+    # async-checkpoint scenario (PR8): both legs emitted, overhead
+    # < 5% (bench takes best-of-3 pairwise attempts against host
+    # pressure), every committed checkpoint verified, BENCH_pr8.json
+    ck = _ckpt_rec(recs)
+    assert ck, names
+    assert ck["committed"] >= 1, ck
+    assert any(n.startswith("checkpoint_off_superstep") for n in names)
+    pr8 = json.load(open(tmp_path / "BENCH_pr8.json"))
+    assert pr8["scenario"] == "checkpoint" and pr8["verified"], pr8
+    if not ck["overhead_pct"] < 5.0:
+        ck, res2 = _rerun_checkpoint_probe(env)
+        assert ck and ck["overhead_pct"] < 5.0, \
+            (ck, res.stderr[-1000:], res2.stderr[-1000:])
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Checkpoint linter: manifest / checksum / completeness verification
+for any ``mxnet_tpu.resilience`` checkpoint directory.
+
+    python tools/verify_checkpoint.py <ckpt_root_or_step_dir> [...]
+    python tools/verify_checkpoint.py --all <ckpt_root>
+
+Exit code 0 = every checked checkpoint verified; 1 = problems found
+(each printed). ``--all`` checks every committed step under a root,
+not just the latest — the pre-flight for "can I actually resume from
+this directory" before tearing down the old pool.
+
+The checks (shared with ``resilience.checkpoint.verify`` — the loader
+enforces the same invariants at restore time):
+
+- the manifest parses and declares a known format version;
+- the payload length matches the manifest;
+- every tensor's bytes lie inside the payload and match their CRC32;
+- every tensor's shape x dtype agrees with its byte length;
+- every extra file (SPMD shard sets) exists with matching length+CRC;
+- declared optimizer-state kinds have their tensors present.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _check_one(path):
+    from mxnet_tpu.resilience import checkpoint as ck
+
+    problems = ck.verify(path)
+    target = path
+    if not os.path.exists(os.path.join(path, ck.MANIFEST)):
+        latest = ck.latest_checkpoint(path)
+        if latest:
+            target = latest
+    label = os.path.relpath(target)
+    if problems:
+        print(f"FAIL {label}")
+        for p in problems:
+            print(f"  - {p}")
+        return False
+    man_path = os.path.join(target, ck.MANIFEST)
+    try:
+        with open(man_path) as f:
+            man = json.load(f)
+        n = len(man.get("tensors", {})) + len(man.get("files", {}))
+        print(f"OK   {label}: step {man.get('step')} "
+              f"({n} tensors/files, {man.get('payload_bytes', 0)} payload "
+              f"bytes, reason={man.get('reason')!r}, "
+              f"kind={man.get('extras', {}).get('kind')!r})")
+    except (OSError, ValueError):
+        print(f"OK   {label}")
+    return True
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="verify mxnet_tpu checkpoint integrity")
+    ap.add_argument("paths", nargs="+",
+                    help="checkpoint roots or step_* dirs")
+    ap.add_argument("--all", action="store_true",
+                    help="check every committed step under each root, "
+                         "not just the latest")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.resilience import checkpoint as ck
+
+    ok = True
+    for path in args.paths:
+        targets = [path]
+        if args.all and not os.path.exists(os.path.join(path, ck.MANIFEST)):
+            steps = ck._committed_steps(path)
+            if steps:
+                targets = [os.path.join(path, ck._step_dirname(s))
+                           for s in steps]
+        for t in targets:
+            ok = _check_one(t) and ok
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
