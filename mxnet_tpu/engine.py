@@ -9,9 +9,9 @@ every op dispatch — SURVEY.md §5.2)."""
 from __future__ import annotations
 
 import contextlib
-import os
 
 from . import observability as _obs
+from .base import getenv
 
 _BULK = {"size": 15}
 
@@ -32,7 +32,7 @@ def bulk(size):
 
 def sync_exec_enabled() -> bool:
     """NaiveEngine analog: MXTPU_SYNC_EXEC=1 -> block after every op."""
-    return os.environ.get("MXTPU_SYNC_EXEC", "0") == "1"
+    return bool(getenv("MXTPU_SYNC_EXEC", False, dtype=bool))
 
 
 _RELAY = None  # lazily probed: does block_until_ready actually block?
@@ -47,7 +47,7 @@ def _on_relay() -> bool:
     only correct sync there is a dependent read."""
     global _RELAY
     if _RELAY is None:
-        force = os.environ.get("MXTPU_RELAY_SYNC")
+        force = getenv("MXTPU_RELAY_SYNC")
         if force is not None:
             _RELAY = force == "1"
         else:

@@ -288,7 +288,9 @@ class Trainer:
         total = sq[0]
         for s in sq[1:]:
             total = total + s
-        return float(jnp.sqrt(total))
+        # deliberate eager-path sync, documented in docs/observability.md
+        # overhead notes (the fused path returns a LAZY device scalar)
+        return float(jnp.sqrt(total))  # mxtpu-lint: host-sync-ok
 
     def allreduce_grads(self):
         if not self._kv_initialized:

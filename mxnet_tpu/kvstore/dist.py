@@ -18,7 +18,6 @@ wire-speed AllReduce, unlike the round-1 allgather+host-sum fallback.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 
 import numpy as _np
@@ -253,11 +252,11 @@ def init_distributed(coordinator_address=None, num_processes=None, process_id=No
     just call ``init_distributed()`` under ``tools/launch.py``.
     """
     if coordinator_address is None:
-        coordinator_address = os.environ.get("MXTPU_COORDINATOR")
-    if num_processes is None and "MXTPU_NUM_PROCESSES" in os.environ:
-        num_processes = int(os.environ["MXTPU_NUM_PROCESSES"])
-    if process_id is None and "MXTPU_PROCESS_ID" in os.environ:
-        process_id = int(os.environ["MXTPU_PROCESS_ID"])
+        coordinator_address = getenv("MXTPU_COORDINATOR")
+    if num_processes is None:
+        num_processes = getenv("MXTPU_NUM_PROCESSES", None, dtype=int)
+    if process_id is None:
+        process_id = getenv("MXTPU_PROCESS_ID", None, dtype=int)
     from .. import runtime
 
     # collective SETUP is the flakiest moment of a pod bring-up (the

@@ -237,6 +237,12 @@ class MetricsRegistry:
     _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram,
               "series_gauge": SeriesGauge}
 
+    #: lock protocol, machine-checked by mxtpu-lint's thread-guard rule:
+    #: registration mutates the name->metric map only under _lock (reads
+    #: are deliberately lock-free — the GIL covers dict lookups, and the
+    #: hot paths record without taking a lock).
+    _GUARDED_BY = {"_metrics": "_lock"}
+
     def __init__(self):
         self._metrics = {}
         self._lock = threading.Lock()

@@ -605,9 +605,9 @@ _PALLAS_BWD_MAX_T = 8192
 def _flash_bwd_rule(scale, causal, block_size, window, native_gqa, res, g):
     q, k, v, out, lse = res
     group = q.shape[1] // k.shape[1]
-    import os as _os
+    from ..base import getenv
 
-    _fused = _os.environ.get("MXTPU_FLASH_BWD", "split") == "fused"
+    _fused = getenv("MXTPU_FLASH_BWD", "split") == "fused"
     use_native = (native_gqa and group > 1
                   and _pallas_ready(q, k, causal, block_size)
                   # only the FUSED backward's full-T dq scratch caps the

@@ -560,10 +560,13 @@ class SPMDTrainStep:
             # host-row predict probe as __call__
             import numpy as onp
 
+            # one-time deferred-init probe (self._state is None exactly
+            # once), never on the per-superstep path
             if isinstance(raw_x, jax.Array) and raw_x.addressable_shards:
-                host = onp.asarray(raw_x.addressable_shards[0].data)
+                host = onp.asarray(  # mxtpu-lint: host-sync-ok
+                    raw_x.addressable_shards[0].data)
             else:
-                host = onp.asarray(raw_x)
+                host = onp.asarray(raw_x)  # mxtpu-lint: host-sync-ok
             xin = NDArray(jnp.asarray(host[0][0:1] if host[0].ndim and
                                       host[0].shape[0] > 1 else host[0]))
             with autograd.predict_mode():

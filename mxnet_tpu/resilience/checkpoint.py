@@ -566,6 +566,11 @@ class CheckpointManager:
     synchronously, ordered BEFORE the flight-recorder bundle.
     """
 
+    #: lock protocol, machine-checked by mxtpu-lint's thread-guard rule
+    #: (the PR-8 flush() race was exactly an off-lock mutation of this
+    #: accounting): pending-snapshot count only moves under the condvar.
+    _GUARDED_BY = {"_pending": "_cv"}
+
     def __init__(self, directory, every_n_steps=100, keep=_KEEP_DEFAULT,
                  net=None, trainer=None, ring=None, install_sigterm=True):
         self.directory = str(directory)

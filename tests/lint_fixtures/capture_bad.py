@@ -1,0 +1,54 @@
+"""Seeded violations for the capture-unsafe-in-graph rule (clean twin:
+capture_clean.py): trace-unsafe Python inside functions that become
+jit / scan bodies."""
+
+import os
+import random
+import time
+
+import numpy as np
+
+_CALLS = 0
+
+
+def body(carry, x):
+    t = time.time()                       # violation: trace-time constant
+    noise = np.random.normal()            # violation: one draw at trace
+    jitter = random.random()              # violation: one draw at trace
+    print("tracing", carry)               # violation: prints once
+    mode = os.environ.get("MXTPU_MODE")   # violation: env read at trace
+    global _CALLS                         # violation: global mutation
+    _CALLS += 1
+    return carry + x + noise + jitter, (t, mode)
+
+
+def run(xs):
+    import jax
+
+    return jax.lax.scan(body, 0.0, xs)
+
+
+def fwd(params, x):
+    print("fwd trace")                    # violation: decorated jit body
+    return params @ x
+
+
+def build():
+    import jax
+
+    return jax.jit(fwd)
+
+
+def branch_true(x):
+    return x + 1
+
+
+def branch_false(x):
+    return x * np.random.rand()           # violation: a cond BRANCH
+    # (beyond arg 0) is a traced body too
+
+
+def choose(pred, x):
+    from jax import lax
+
+    return lax.cond(pred, branch_true, branch_false, x)

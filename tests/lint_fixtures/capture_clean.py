@@ -1,0 +1,25 @@
+"""Clean twin of capture_bad.py: the same shapes with the unsafe work
+hoisted OUT of the traced bodies (operands in, logging outside)."""
+
+import time
+
+
+def body(carry, slot):
+    x, key = slot                     # randomness rides in as operands
+    return carry + x, key
+
+
+def run(xs, keys):
+    import jax
+
+    t0 = time.time()                  # host timing OUTSIDE the graph
+    out = jax.lax.scan(body, 0.0, (xs, keys))
+    print("scan took", time.time() - t0)
+    return out
+
+
+def helper(x):
+    # not a graph body anywhere in this file: unsafe-for-trace calls
+    # are fine in plain host code
+    print("host-side", time.time())
+    return x

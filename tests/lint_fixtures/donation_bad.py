@@ -1,0 +1,15 @@
+"""Seeded violations for the donation-after-use rule (clean twin:
+donation_clean.py). `_apply_fused_update` donates args 0 and 2; the
+`donates=` annotation marks an ad-hoc donating call line."""
+
+
+def step(ws, gs, sts, update):
+    new_ws, new_sts = _apply_fused_update(ws, gs, sts, update)  # noqa: F821
+    norm = sum(w.sum() for w in ws)   # violation: ws donated above
+    return new_ws, new_sts, norm
+
+
+def dispatch(fn, args, introspect):
+    out = fn(*args)  # mxtpu-lint: donates=args
+    introspect.record(args)           # violation: args donated above
+    return out

@@ -1,0 +1,18 @@
+"""Clean twin of donation_bad.py: capture-before-donate, rebinding,
+and branch-isolated reads (an `else` branch must not be poisoned by a
+donation in the `if` branch)."""
+
+
+def step(ws, gs, sts, update, introspect):
+    avals = introspect.avals_of(ws)   # captured BEFORE the donation
+    new_ws, new_sts = _apply_fused_update(ws, gs, sts, update)  # noqa: F821
+    ws = new_ws                       # rebound: the name is fresh again
+    return ws, new_sts, avals
+
+
+def dispatch(fn, args, instrumented):
+    if instrumented:
+        out = _dispatch_call("site", "span", fn, args)  # noqa: F821
+    else:
+        out = fn(*args)               # sibling branch: args not donated
+    return out

@@ -1,0 +1,16 @@
+"""Seeded violations for the env-var-discipline rule (clean twin:
+env_clean.py): direct os.environ reads of MXTPU_* names, and a name
+that is nowhere in docs/env_vars.md."""
+
+import os
+
+
+def depth():
+    return int(os.environ.get("MXTPU_FIXTURE_KNOB", "2"))  # violation x2
+    # (direct read bypassing the accessor + undocumented name)
+
+
+def rank():
+    if "MXTPU_FIXTURE_RANK" in os.environ:     # violation: membership read
+        return int(os.environ["MXTPU_FIXTURE_RANK"])  # violation: [] read
+    return 0

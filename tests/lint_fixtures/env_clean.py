@@ -1,0 +1,16 @@
+"""Clean twin of env_bad.py: documented names through the accessor,
+and an env WRITE (launcher-style child env setup), which is allowed."""
+
+import os
+
+from mxnet_tpu.base import getenv
+
+
+def telemetry_on():
+    return bool(getenv("MXTPU_TELEMETRY", False, dtype=bool))
+
+
+def child_env(rank):
+    env = dict(os.environ)
+    os.environ["MXTPU_PROCESS_ID"] = str(rank)   # write: allowed
+    return env

@@ -1,0 +1,28 @@
+"""Seeded violations for the thread-guard rule (clean twin:
+guard_clean.py): _GUARDED_BY-declared state mutated off-lock."""
+
+import threading
+
+
+class Writer:
+    _GUARDED_BY = {"_pending": "_lock", "_queue_depth": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0             # __init__ is exempt
+        self._queue_depth = 0
+
+    def enqueue(self):
+        self._pending += 1            # violation: no lock held
+
+    def drain(self):
+        with self._lock:
+            self._pending -= 1
+        self._queue_depth = 0         # violation: outside the with block
+
+    def submit(self, executor):
+        with self._lock:
+            def done_cb(fut):
+                self._pending -= 1    # violation: the closure runs LATER,
+                # when the lock held at its definition site is long gone
+            executor.add_done_callback(done_cb)

@@ -1,0 +1,28 @@
+"""Clean twin of guard_bad.py: every declared mutation under its lock
+(nested with-blocks count), undeclared attributes unconstrained."""
+
+import threading
+
+
+class Writer:
+    _GUARDED_BY = {"_pending": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = 0
+
+    def enqueue(self):
+        with self._lock:
+            self._pending += 1
+
+    def drain(self, cv):
+        with cv:
+            with self._lock:
+                self._pending -= 1
+        self._hint = "drained"        # undeclared attr: unconstrained
+
+    def submit(self, executor):
+        def done_cb(fut):
+            with self._lock:          # the closure takes the lock itself
+                self._pending -= 1
+        executor.add_done_callback(done_cb)

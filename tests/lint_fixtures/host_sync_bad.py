@@ -1,0 +1,11 @@
+"""Seeded violations for the host-sync-in-hot-path rule (the clean
+twin is host_sync_clean.py). Never imported — parsed by mxtpu-lint."""
+
+import numpy as np
+
+
+def hot_step(batch, metrics):  # mxtpu-lint: hot-path
+    loss = batch.mean()
+    metrics.append(loss.item())       # violation: .item() scalar sync
+    host = np.asarray(loss)           # violation: host materialization
+    return float(loss), host          # violation: float() on array

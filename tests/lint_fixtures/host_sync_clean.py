@@ -1,0 +1,16 @@
+"""Clean twin of host_sync_bad.py: a hot function that keeps values
+lazy, and a COLD function where host syncs are allowed."""
+
+import numpy as np
+
+
+def hot_step(batch, metrics):  # mxtpu-lint: hot-path
+    loss = batch.mean()
+    metrics.set_lazy(loss)            # lazy device scalar: fine
+    n = int(batch.shape[0])           # static shape metadata: fine
+    return loss, n
+
+
+def cold_summary(batch):
+    # not marked hot: host materialization is allowed here
+    return float(batch.mean()), np.asarray(batch).tolist()

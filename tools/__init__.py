@@ -1,0 +1,2 @@
+"""Repo tooling. A package so ``python -m tools.mxtpu_lint`` works the
+same from any checkout; the scripts here also run directly by path."""

@@ -1,115 +1,43 @@
 #!/usr/bin/env python
-"""Static telemetry-coverage check: every metric name, trace-event
-series, and ``mxtpu_xla_dispatch_total`` site emitted anywhere in
-``mxnet_tpu/`` must appear in the docs/observability.md coverage map.
+"""Static telemetry-coverage check — THIN SHIM.
 
-Runs as a tier-1 test (tests/test_telemetry_coverage.py), so a new
-instrumentation site cannot land undocumented — the coverage map is
-what operators grep when an unknown series shows up on a dashboard.
-
-Pure stdlib, no jax import: usable anywhere, runs in milliseconds.
+The actual analysis moved into the shared mxtpu-lint engine
+(``tools/mxtpu_lint/rules/telemetry.py``, rule ``telemetry-coverage``)
+so there is ONE analysis framework, not two; this file keeps the
+original CLI and importable API (``check``/``collect_emitted``/``main``)
+for existing callers and tests/test_telemetry_coverage.py.
 
     python tools/check_telemetry_coverage.py            # repo root cwd
     python tools/check_telemetry_coverage.py --root /path/to/repo
+
+Prefer ``python -m tools.mxtpu_lint`` for new workflows — it runs this
+check plus the other fast-path invariant rules.
 """
 
 from __future__ import annotations
 
-import argparse
 import os
-import re
 import sys
 
-#: Prometheus-style metric names (the registry enforces this prefix by
-#: convention — every catalog entry starts mxtpu_)
-_METRIC_RE = re.compile(r'"(mxtpu_[a-z0-9_]+)"')
-
-#: trace-event series: tracer record()/instant()/span() first string
-#: argument. f-string names normalize to their literal prefix (e.g.
-#: ``cachedop.compile[{block}]`` -> ``cachedop.compile[``), matched as
-#: a substring of the docs.
-_TRACE_RE = re.compile(
-    r'\.(?:record|instant|span)\(\s*f?"([A-Za-z_][\w.\[\]{}]*)"')
-
-#: executable-dispatch site labels (mxtpu_xla_dispatch_total{site=...})
-_SITE_RE = re.compile(r'record_xla_dispatch\(\s*"([a-z0-9_]+)"')
-
-#: names that are not emitted series (helper strings the regexes also
-#: catch) — extend here, with a comment why, when a literal needs
-#: exempting.
-_IGNORE: set = {
-    # C ABI symbols of the custom-op library loader (library.py cdef),
-    # not telemetry series
-    "mxtpu_lib_num_ops", "mxtpu_lib_op_name", "mxtpu_lib_op_num_inputs",
-    "mxtpu_lib_op_infer_shape", "mxtpu_lib_op_compute",
-}
-
-
-def collect_emitted(pkg_dir):
-    """``{kind: {name: [files...]}}`` for every telemetry name emitted
-    under ``pkg_dir``."""
-    found = {"metric": {}, "trace": {}, "site": {}}
-    for dirpath, _dirnames, filenames in os.walk(pkg_dir):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
-            for name in _METRIC_RE.findall(text):
-                if name not in _IGNORE:
-                    found["metric"].setdefault(name, []).append(rel)
-            for name in _TRACE_RE.findall(text):
-                name = name.split("{")[0]  # f-string -> literal prefix
-                if name and name not in _IGNORE:
-                    found["trace"].setdefault(name, []).append(rel)
-            for name in _SITE_RE.findall(text):
-                found["site"].setdefault(name, []).append(rel)
-    return found
-
-
-def check(root=None):
-    """Returns ``(missing, found)`` where missing is a list of
-    ``(kind, name, files)`` entries absent from docs/observability.md."""
-    root = root or os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__)))
-    pkg = os.path.join(root, "mxnet_tpu")
-    docs_path = os.path.join(root, "docs", "observability.md")
-    with open(docs_path, encoding="utf-8") as f:
-        docs = f.read()
-    found = collect_emitted(pkg)
-    missing = []
-    for kind, names in found.items():
-        for name, files in sorted(names.items()):
-            if name not in docs:
-                missing.append((kind, name, sorted(set(files))))
-    return missing, found
-
-
-def main(argv=None):
-    ap = argparse.ArgumentParser(
-        description="check telemetry names against docs/observability.md")
-    ap.add_argument("--root", default=None,
-                    help="repo root (default: this file's parent's parent)")
-    args = ap.parse_args(argv)
-    missing, found = check(args.root)
-    n = sum(len(v) for v in found.values())
-    if not missing:
-        print(f"telemetry coverage OK: {n} emitted names all documented "
-              "in docs/observability.md")
-        return 0
-    print(f"telemetry coverage FAILED: {len(missing)} of {n} emitted "
-          "names missing from docs/observability.md:", file=sys.stderr)
-    for kind, name, files in missing:
-        print(f"  [{kind}] {name}  (emitted in {', '.join(files)})",
-              file=sys.stderr)
-    print("document each name in the docs/observability.md coverage map "
-          "(metric catalog / tracer section), or exempt it with a "
-          "comment in tools/check_telemetry_coverage.py::_IGNORE",
-          file=sys.stderr)
-    return 1
-
+try:
+    # imported as tools.check_telemetry_coverage: stay inside the same
+    # package so there is ONE mxtpu_lint module object (registry, types)
+    from .mxtpu_lint.rules.telemetry import (  # noqa: F401 - re-exports
+        _IGNORE, check, collect_emitted, main)
+except ImportError:
+    # direct script run / imported top-level with tools/ on sys.path:
+    # import the package by its sibling name, without leaving a
+    # permanent sys.path entry behind
+    _HERE = os.path.dirname(os.path.abspath(__file__))
+    _ADDED = _HERE not in sys.path
+    if _ADDED:
+        sys.path.insert(0, _HERE)
+    try:
+        from mxtpu_lint.rules.telemetry import (  # noqa: F401
+            _IGNORE, check, collect_emitted, main)
+    finally:
+        if _ADDED:
+            sys.path.remove(_HERE)
 
 if __name__ == "__main__":
     sys.exit(main())

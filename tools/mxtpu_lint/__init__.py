@@ -1,0 +1,16 @@
+"""mxtpu-lint: framework-aware static analysis for the fast-path
+invariants (host-sync, donation, capture-safety, env/thread
+discipline), run as a tier-1 gate.
+
+    python -m tools.mxtpu_lint                  # baseline-aware check
+    python -m tools.mxtpu_lint --update-baseline
+    python -m tools.mxtpu_lint --no-baseline    # every finding
+
+See docs/static_analysis.md for the rule catalog, suppression syntax
+and baseline workflow.
+"""
+
+from . import rules  # noqa: F401 - registers the rule catalog
+from .engine import (BASELINE_RELPATH, DEFAULT_TARGETS, Finding,  # noqa: F401
+                     LintContext, PyFile, REGISTRY, Rule, apply_baseline,
+                     load_baseline, register, run, write_baseline)

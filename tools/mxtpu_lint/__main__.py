@@ -1,0 +1,120 @@
+"""CLI: ``python -m tools.mxtpu_lint [--baseline PATH] [--update-baseline]``.
+
+Exit codes: 0 = no new findings (baseline-frozen ones are reported as a
+count only), 1 = new findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (BASELINE_RELPATH, DEFAULT_TARGETS, REGISTRY,
+               apply_baseline, load_baseline, run, write_baseline)
+
+
+def repo_root():
+    """tools/mxtpu_lint/__main__.py -> the repo root two levels up."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.mxtpu_lint",
+        description="framework-aware static analysis for the mxnet_tpu "
+                    "fast-path invariants (docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: "
+                         f"{', '.join(DEFAULT_TARGETS)} under --root)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: autodetected from this "
+                         "file's location)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"baseline JSON (default: {BASELINE_RELPATH} "
+                         "under the root when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, frozen or not")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to freeze the current "
+                         "findings (sorted, stable JSON)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(REGISTRY):
+            print(f"{name:28s} {REGISTRY[name].doc}")
+        return 0
+
+    root = os.path.abspath(args.root or repo_root())
+    if not os.path.isdir(os.path.join(root, "mxnet_tpu")) and \
+            args.root is None and not args.paths:
+        print(f"mxtpu-lint: {root} does not look like the repo root "
+              "(no mxnet_tpu/); pass --root", file=sys.stderr)
+        return 2
+
+    for r in args.rule or []:
+        if r not in REGISTRY:
+            print(f"mxtpu-lint: unknown rule {r!r} (see --list-rules)",
+                  file=sys.stderr)
+            return 2
+
+    files = None
+    if args.paths:
+        files = []
+        for p in args.paths:
+            p = os.path.abspath(p)
+            if os.path.isdir(p):
+                from .engine import iter_source_files
+
+                files.extend(iter_source_files(os.path.dirname(p),
+                                               (os.path.basename(p),)))
+            else:
+                files.append(p)
+
+    findings, _ctx = run(root, rules=args.rule, files=files)
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_RELPATH)
+    if args.update_baseline:
+        entries = write_baseline(baseline_path, findings)
+        print(f"mxtpu-lint: baseline updated: {len(entries)} finding(s) "
+              f"frozen in {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    entries = [] if args.no_baseline else load_baseline(baseline_path)
+    new, frozen, stale = apply_baseline(findings, entries)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new],
+            "frozen": len(frozen), "stale_baseline": len(stale),
+            "rules": sorted(REGISTRY)}, indent=1, sort_keys=True))
+        return 1 if new else 0
+
+    for f in new:
+        print(f"{f.file}:{f.line}: [{f.rule}] {f.message}")
+    n_rules = len(args.rule or REGISTRY)
+    if new:
+        print(f"\nmxtpu-lint: {len(new)} NEW finding(s) "
+              f"({len(frozen)} baseline-frozen, {n_rules} rules). "
+              "Fix them, annotate a deliberate exception "
+              "(docs/static_analysis.md), or — for a pre-existing "
+              "issue only — refreeze with --update-baseline.",
+              file=sys.stderr)
+        return 1
+    extra = f", {len(stale)} stale baseline entr" + \
+        ("y" if len(stale) == 1 else "ies") if stale else ""
+    print(f"mxtpu-lint OK: 0 new findings ({len(frozen)} baseline-frozen"
+          f"{extra}, {n_rules} rules)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

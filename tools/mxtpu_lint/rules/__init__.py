@@ -1,0 +1,6 @@
+"""mxtpu-lint rule catalog. Importing this package registers every
+rule with the engine registry (see docs/static_analysis.md for the
+catalog with rationale)."""
+
+from . import (capture, donation, env_vars, host_sync, telemetry,
+               thread_guard)  # noqa: F401 - import-for-registration

@@ -1,0 +1,160 @@
+"""Rule: capture-unsafe-in-graph.
+
+Bug class retired: trace-unsafe Python inside a function that becomes
+an XLA graph body. ``jax.jit``/``lax.scan`` run the Python ONCE at
+trace time — a ``time.time()``, ``np.random`` draw, ``os.environ``
+read, ``print`` or global mutation silently bakes a trace-time
+constant (or side effect) into every later dispatch. This is exactly
+the graph boundary the paper's hybridize story warns about: Python-side
+sloppiness does not error, it just quietly destroys semantics (the
+PR-8 flush() race and the 0-d momentum reset were both found at this
+boundary).
+
+Graph bodies are identified two ways:
+- decorator analysis: ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+  ``@jax.pmap``, ``@pjit``, ``@jax.checkpoint``/``remat``;
+- registration-site analysis: a local ``def f`` later passed to
+  ``jax.jit(f, ...)`` / ``lax.scan(f, ...)`` / ``jax.vjp(f, ...)`` /
+  ``jax.grad(f)`` etc. anywhere in the same file.
+
+Nested defs inside a graph body are graph bodies too (the ``body`` fn
+of a ``lax.scan`` inside a jitted superstep).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import (Finding, Rule, call_name, dotted_name,
+                      func_qualnames, module_aliases, register)
+
+#: callees whose FIRST function-valued argument becomes a traced body
+GRAPH_TAKING_CALLS = (
+    "jit", "pmap", "pjit", "scan", "vjp", "grad", "value_and_grad",
+    "checkpoint", "remat", "while_loop", "fori_loop", "cond", "switch",
+    "custom_vjp", "linearize",
+)
+
+#: decorators that mark a function as a graph body
+GRAPH_DECORATORS = ("jit", "pmap", "pjit", "checkpoint", "remat")
+
+
+def _decorated_graph(fn):
+    for dec in fn.decorator_list:
+        name = dotted_name(dec) or call_name(dec)
+        if name and name.rsplit(".", 1)[-1] in GRAPH_DECORATORS:
+            return True
+        # @partial(jax.jit, ...) / @functools.partial(jit, ...)
+        if isinstance(dec, ast.Call):
+            dname = dotted_name(dec.func)
+            if dname and dname.rsplit(".", 1)[-1] == "partial" and \
+                    dec.args:
+                inner = dotted_name(dec.args[0])
+                if inner and inner.rsplit(".", 1)[-1] in GRAPH_DECORATORS:
+                    return True
+    return False
+
+
+@register
+class CaptureRule(Rule):
+    name = "capture-unsafe-in-graph"
+    doc = ("no time/np.random/random/os.environ/print/global-mutation "
+           "inside functions that become jit or scan bodies")
+
+    def check_file(self, pf, ctx):
+        funcs = func_qualnames(pf.tree)
+        by_name = {}
+        for qual, fn in funcs:
+            by_name.setdefault(fn.name, []).append(fn)
+        # registration sites: names passed where a traced body goes
+        registered = set()
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = call_name(node)
+            if not cname or cname.rsplit(".", 1)[-1] not in \
+                    GRAPH_TAKING_CALLS:
+                continue
+            # every function-valued operand traces: scan's body is arg 0,
+            # cond carries true_fn AND false_fn, switch takes N branches
+            # (positionally or as keywords) — a Name that is not a local
+            # function simply never matches a def below
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    registered.add(arg.id)
+            for kw in node.keywords:
+                if isinstance(kw.value, ast.Name):
+                    registered.add(kw.value.id)
+        graph_fns = []
+        for qual, fn in funcs:
+            if fn.name in registered or _decorated_graph(fn):
+                graph_fns.append((qual, fn))
+        if not graph_fns:
+            return []
+        np_aliases = module_aliases(pf.tree, "numpy")
+        random_aliases = module_aliases(pf.tree, "random")
+        os_aliases = module_aliases(pf.tree, "os")
+        time_aliases = module_aliases(pf.tree, "time")
+        findings, seen = [], set()
+        for qual, fn in graph_fns:
+            if id(fn) in seen:
+                continue
+            # nested defs are traced along with the enclosing body
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    seen.add(id(sub))
+            findings.extend(self._check_body(
+                pf, qual, fn, np_aliases, random_aliases, os_aliases,
+                time_aliases))
+        return findings
+
+    def _check_body(self, pf, qual, fn, np_al, rand_al, os_al, time_al):
+        out = []
+
+        def finding(node, what, why):
+            out.append(Finding(
+                self.name, pf.relpath, node.lineno,
+                f"{what} inside graph body {qual}() {why} — it runs "
+                f"once at trace time, not per dispatch; hoist it out of "
+                f"the traced function (pass values in as operands)"))
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                finding(node, "`global` mutation",
+                        "bakes a trace-time side effect")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not name:
+                continue
+            head, _, tail = name.partition(".")
+            if name == "print":
+                finding(node, "`print(...)`",
+                        "prints once at trace time only")
+            elif head in time_al and tail in ("time", "perf_counter",
+                                              "monotonic", "time_ns"):
+                finding(node, f"`{name}()`", "bakes a trace-time constant")
+            elif head in np_al and tail.startswith("random"):
+                finding(node, f"`{name}(...)`",
+                        "draws ONE value at trace time (use jax.random "
+                        "with an operand key)")
+            elif head in rand_al and "." not in tail and tail:
+                finding(node, f"`{name}(...)`",
+                        "draws ONE value at trace time (use jax.random "
+                        "with an operand key)")
+            elif (head in os_al and tail in ("getenv",)) or \
+                    (head in os_al and tail.startswith("environ")):
+                finding(node, f"`{name}(...)`",
+                        "reads the environment at trace time")
+        # os.environ[...] subscripts (reads without a call)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Subscript):
+                base = dotted_name(node.value)
+                if base and "." in base:
+                    h, _, t = base.partition(".")
+                    if h in os_al and t == "environ":
+                        finding(node, f"`{base}[...]`",
+                                "reads the environment at trace time")
+        return out

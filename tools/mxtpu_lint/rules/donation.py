@@ -1,0 +1,188 @@
+"""Rule: donation-after-use.
+
+Bug class retired: the PR-7 introspection bug — ``avals_of(args)`` was
+captured AFTER the donating fused-update call, reading buffers XLA had
+already reused in place (garbage avals, and on a real accelerator a
+use-after-free). A donating executable consumes its donated operands;
+any later read of the same Python variable in that scope is at best
+stale and at worst deallocated.
+
+The analysis is intra-function and branch-aware: a variable passed at
+a donated argument position of a known donating call-site must not be
+read on any path BELOW the donating call unless it is reassigned
+first (sibling ``if``/``else`` branches do not poison each other).
+Donating call-sites are the built-in map below plus any call line
+annotated ``# mxtpu-lint: donates=<var>[,<var>...]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, Rule, call_name, func_qualnames, register
+
+#: callee name -> positional indices whose argument buffers are donated.
+#: These mirror the real ``donate_argnums`` at the jit sites:
+#:  - trainer.py ``fused_jit = jax.jit(fused, donate_argnums=(0, 2))``
+#:    called through ``_apply_fused_update(ws, gs, sts, ...)`` whose
+#:    (0, 2) = weights + optimizer states,
+#:  - ``_dispatch_call(site, span, fn, args)``: ``args`` feeds a
+#:    donating executable (fused update / superstep scan).
+DONATING_CALLS = {
+    "_apply_fused_update": (0, 2),
+    "_dispatch_call": (3,),
+}
+
+_DONATES_RE = re.compile(r"#\s*mxtpu-lint:\s*donates=([\w,\s]+)")
+
+
+def _expr_walk(node):
+    """Walk an expression WITHOUT descending into nested function /
+    lambda scopes."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+@register
+class DonationRule(Rule):
+    name = "donation-after-use"
+    doc = ("a variable passed at a donated position of a donating "
+           "call-site must not be read again in the same scope")
+
+    def check_file(self, pf, ctx):
+        # per-line annotations: "# mxtpu-lint: donates=args, ws"
+        annotated = {}
+        for i, line in enumerate(pf.lines, start=1):
+            m = _DONATES_RE.search(line)
+            if m:
+                annotated[i] = {v.strip() for v in m.group(1).split(",")
+                                if v.strip()}
+        findings = []
+        for qual, fn in func_qualnames(pf.tree):
+            findings.extend(_FnScan(pf, qual, annotated).run(fn))
+        return findings
+
+
+class _FnScan:
+    """Branch-aware linear scan of one function body. ``donated`` maps
+    variable name -> (line, callee-description); branches fork it and
+    merge by union (donated on EITHER path counts below the join)."""
+
+    def __init__(self, pf, qual, annotated):
+        self.pf = pf
+        self.qual = qual
+        self.annotated = annotated
+        self.findings = []
+
+    def run(self, fn):
+        donated = {}
+        self._stmts(fn.body, donated)
+        return self.findings
+
+    # -- statement dispatch ---------------------------------------------
+    def _stmts(self, body, donated):
+        for stmt in body:
+            self._stmt(stmt, donated)
+
+    def _stmt(self, stmt, donated):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested scopes get their own pass
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, donated)
+            d1, d2 = dict(donated), dict(donated)
+            self._stmts(stmt.body, d1)
+            self._stmts(stmt.orelse, d2)
+            donated.clear()
+            donated.update(d2)
+            donated.update(d1)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter, donated)
+            self._store_target(stmt.target, donated)
+            self._stmts(stmt.body, donated)
+            self._stmts(stmt.orelse, donated)
+        elif isinstance(stmt, ast.While):
+            self._expr(stmt.test, donated)
+            self._stmts(stmt.body, donated)
+            self._stmts(stmt.orelse, donated)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr, donated)
+                if item.optional_vars is not None:
+                    self._store_target(item.optional_vars, donated)
+            self._stmts(stmt.body, donated)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body, donated)
+            merged = dict(donated)
+            for h in stmt.handlers:
+                dh = dict(donated)
+                self._stmts(h.body, dh)
+                merged.update(dh)
+            self._stmts(stmt.orelse, donated)
+            merged.update(donated)
+            donated.clear()
+            donated.update(merged)
+            self._stmts(stmt.finalbody, donated)
+        else:
+            # simple statement: loads checked first, then donations
+            # take effect, then stores clear (handles `args = f(args)`)
+            self._expr(stmt, donated)
+
+    # -- expression-level events ----------------------------------------
+    def _expr(self, node, donated):
+        loads, stores, donations = [], [], []
+        for n in _expr_walk(node):
+            if isinstance(n, ast.Name):
+                if isinstance(n.ctx, ast.Load):
+                    loads.append(n)
+                else:
+                    stores.append(n.id)
+            elif isinstance(n, ast.Call):
+                donations.extend(self._donated_vars(n))
+        for n in loads:
+            if n.id in donated:
+                dline, dcallee = donated[n.id]
+                if n.lineno > dline:
+                    self.findings.append(Finding(
+                        DonationRule.name, self.pf.relpath, n.lineno,
+                        f"`{n.id}` is read after being donated to "
+                        f"{dcallee} (line {dline}) in {self.qual}(); "
+                        f"the buffer may already be reused by XLA — "
+                        f"capture what you need before the donating "
+                        f"call or rebind the variable"))
+                    donated.pop(n.id)  # one report per donation
+        for name, line, callee in donations:
+            donated[name] = (line, callee)
+        for name in stores:
+            donated.pop(name, None)
+
+    def _store_target(self, target, donated):
+        for n in _expr_walk(target):
+            if isinstance(n, ast.Name):
+                donated.pop(n.id, None)
+
+    def _donated_vars(self, call):
+        """-> [(var_name, line, callee_desc)] donated by this call."""
+        out = []
+        name = call_name(call)
+        tail = name.rsplit(".", 1)[-1] if name else None
+        end = getattr(call, "end_lineno", call.lineno)
+        ann = self.annotated.get(call.lineno) or self.annotated.get(end)
+        if ann:
+            for node in _expr_walk(call):
+                if isinstance(node, ast.Name) and node.id in ann:
+                    out.append((node.id, end, f"`{name or '<call>'}`"))
+        if tail in DONATING_CALLS:
+            for idx in DONATING_CALLS[tail]:
+                if idx < len(call.args):
+                    arg = call.args[idx]
+                    if isinstance(arg, ast.Name):
+                        out.append((arg.id, end,
+                                    f"`{tail}` (donated arg {idx})"))
+        return out

@@ -1,0 +1,131 @@
+"""Rule: env-var-discipline.
+
+Bug class retired: configuration drift. Every ``MXTPU_*`` knob must
+(a) be read through the shared accessor (``mxnet_tpu.base.getenv`` /
+a ``runtime`` helper) so typed parsing, defaulting and bool semantics
+live in ONE place, and (b) appear in ``docs/env_vars.md`` — the PR-7
+telemetry gate caught eight undocumented series names the same way;
+this generalizes the doc-join to the configuration surface.
+
+Two checks:
+- direct-read: ``os.environ.get("MXTPU_X")`` / ``os.environ["MXTPU_X"]``
+  / ``os.getenv("MXTPU_X")`` / ``"MXTPU_X" in os.environ`` anywhere
+  outside ``mxnet_tpu/base.py`` (the accessor's own implementation);
+- doc-join (cross-file finalize): every ``MXTPU_*`` name read anywhere
+  in scope must appear in ``docs/env_vars.md``.
+
+Writes (``os.environ["MXTPU_X"] = ...``, launcher child-env setup) are
+fine — the discipline is about reads.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..engine import Finding, Rule, call_name, dotted_name, register
+
+_ENV_NAME_RE = re.compile(r"^MXTPU_[A-Z0-9_]+$")
+
+#: files allowed to touch os.environ for MXTPU_* reads directly
+ACCESSOR_FILES = ("mxnet_tpu/base.py",)
+
+DOCS_PATH = "docs/env_vars.md"
+
+
+def _const_env_name(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and _ENV_NAME_RE.match(node.value):
+        return node.value
+    return None
+
+
+def _env_attr(node):
+    """True when ``node`` is (an alias of) ``os.environ``."""
+    name = dotted_name(node)
+    return bool(name) and name.split(".", 1)[-1] == "environ" and \
+        name.rsplit(".", 2)[0].endswith("os")
+
+
+@register
+class EnvVarRule(Rule):
+    name = "env-var-discipline"
+    doc = ("MXTPU_* reads go through the runtime accessor (base.getenv) "
+           "and every read name must be documented in docs/env_vars.md")
+
+    def check_file(self, pf, ctx):
+        reads = ctx.scratch.setdefault(self.name, {})  # name -> (file, line)
+        findings = []
+
+        def record(name, line):
+            reads.setdefault(name, (pf.relpath, line))
+
+        def raw_read(node, name, how):
+            record(name, node.lineno)
+            if pf.relpath in ACCESSOR_FILES:
+                return
+            findings.append(Finding(
+                self.name, pf.relpath, node.lineno,
+                f"direct {how} read of {name} bypasses the runtime "
+                f"accessor; use mxnet_tpu.base.getenv (typed parsing, "
+                f"bool semantics, one defaulting seam)"))
+
+        # names stored INTO the environment here (writes exempt the
+        # matching membership/read idioms launchers legitimately use)
+        writes = set()
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            _env_attr(t.value):
+                        n = _const_env_name(t.slice)
+                        if n:
+                            writes.add(n)
+
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call):
+                cname = call_name(node)
+                if cname:
+                    tail = cname.rsplit(".", 1)[-1]
+                    if tail in ("get", "getenv") and node.args:
+                        target = node.func.value \
+                            if isinstance(node.func, ast.Attribute) \
+                            else None
+                        # os.environ.get(...) / os.getenv(...)
+                        is_env = (tail == "getenv" and
+                                  cname.endswith("os.getenv")) or \
+                            (target is not None and _env_attr(target))
+                        n = _const_env_name(node.args[0])
+                        if n and is_env:
+                            raw_read(node, n, f"`{cname}`")
+                        elif n and tail == "getenv":
+                            # the blessed accessor (base.getenv /
+                            # runtime helper): still joins the docs
+                            record(n, node.lineno)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _env_attr(node.value):
+                n = _const_env_name(node.slice)
+                if n:
+                    raw_read(node, n, "`os.environ[...]`")
+            elif isinstance(node, ast.Compare) and node.ops and \
+                    isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                    node.comparators and _env_attr(node.comparators[0]):
+                n = _const_env_name(node.left)
+                if n and n not in writes:
+                    raw_read(node, n, "`in os.environ` membership")
+        return findings
+
+    def finalize(self, ctx):
+        docs = ctx.read_doc(DOCS_PATH)
+        reads = ctx.scratch.get(self.name, {})
+        findings = []
+        for name in sorted(reads):
+            if name not in docs:
+                file, line = reads[name]
+                findings.append(Finding(
+                    self.name, file, line,
+                    f"{name} is read here but undocumented — add it to "
+                    f"{DOCS_PATH} (every MXTPU_* knob is operator-"
+                    f"facing surface)"))
+        return findings

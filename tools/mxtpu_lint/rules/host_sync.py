@@ -1,0 +1,151 @@
+"""Rule: host-sync-in-hot-path.
+
+Bug class retired: a stray ``.item()`` / ``float()`` / ``np.asarray``
+on a device value inside the per-step code serializes the pipeline —
+the host blocks on the device, the one-dispatch property survives but
+the overlap dies (the exact failure mode the PR-2/6 fast paths were
+built to avoid, and the reason ``Trainer._grad_norm`` hands back a LAZY
+scalar on the fused path). The rule flags host-materialization calls
+inside functions marked hot; a deliberate, documented sync carries a
+``# mxtpu-lint: host-sync-ok`` annotation at the call site.
+
+Hot set = the built-in map below (dispatch, fused/superstep train
+step, prefetcher staging loop) plus any function whose ``def`` line
+carries ``# mxtpu-lint: hot-path``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..engine import (Finding, Rule, call_name, module_aliases,
+                      func_qualnames, register)
+
+#: (relpath glob, qualname glob) -> the function bodies analyzed.
+#: Keep this list small and genuinely per-step: the rule's value is a
+#: high signal-to-noise gate, not whole-program purity.
+HOT_FUNCTIONS = [
+    # eager op dispatch: every non-hybridized op goes through here
+    ("mxnet_tpu/ops/dispatch.py", "*"),
+    # the fused one-dispatch train step + K-step superstep
+    ("mxnet_tpu/gluon/trainer.py", "Trainer.step"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._step_instrumented"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._step_impl"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._grad_norm"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._allreduce_grads"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._update*"),
+    ("mxnet_tpu/gluon/trainer.py", "Trainer._maybe_fused_update"),
+    ("mxnet_tpu/gluon/trainer.py", "Superstep.step"),
+    ("mxnet_tpu/gluon/trainer.py", "Superstep._dispatch"),
+    # hybridized forward: the CachedGraph call path
+    ("mxnet_tpu/gluon/block.py", "_CachedGraph.__call__"),
+    ("mxnet_tpu/gluon/block.py", "HybridBlock._call_cached"),
+    # async device staging: the producer thread and the consumer's next()
+    ("mxnet_tpu/gluon/data/prefetcher.py", "DevicePrefetcher._produce*"),
+    ("mxnet_tpu/gluon/data/prefetcher.py", "DevicePrefetcher._stage"),
+    ("mxnet_tpu/gluon/data/prefetcher.py", "DevicePrefetcher._convert_leaf"),
+    ("mxnet_tpu/gluon/data/prefetcher.py", "DevicePrefetcher.__next__"),
+    ("mxnet_tpu/gluon/data/prefetcher.py", "SuperstepRing.__next__"),
+    ("mxnet_tpu/gluon/data/prefetcher.py", "_stack_leaves"),
+    # SPMD mesh-side step
+    ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.step"),
+    ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.run_superstep"),
+]
+
+#: int()/float() args that are NEVER device syncs: static shape
+#: metadata, host counters, env reads.
+_SAFE_CAST_CALLEES = {
+    "len", "round", "abs", "min", "max", "ord", "id", "hash",
+    "getenv", "os.getenv", "time.time", "time.perf_counter",
+    "time.monotonic",
+}
+
+
+def _mentions_shape(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim",
+                                                       "size", "dtype",
+                                                       "itemsize"):
+            return True
+    return False
+
+
+@register
+class HostSyncRule(Rule):
+    name = "host-sync-in-hot-path"
+    doc = ("no .item()/float()/int()/np.asarray on device values inside "
+           "hot per-step code without a host-sync-ok annotation")
+
+    def check_file(self, pf, ctx):
+        pats = [q for g, q in HOT_FUNCTIONS
+                if fnmatch.fnmatch(pf.relpath, g)]
+        funcs = func_qualnames(pf.tree)
+        hot = []
+        for qual, fn in funcs:
+            if any(fnmatch.fnmatch(qual, p) for p in pats) or \
+                    fn.lineno in pf.hot_lines or \
+                    (fn.decorator_list and
+                     min(d.lineno for d in fn.decorator_list)
+                     in pf.hot_lines):
+                hot.append((qual, fn))
+        if not hot:
+            return []
+        np_aliases = module_aliases(pf.tree, "numpy")
+        findings = []
+        seen_funcs = set()  # a nested hot def is analyzed once
+        for qual, fn in hot:
+            if id(fn) in seen_funcs:
+                continue
+            seen_funcs.add(id(fn))
+            findings.extend(self._check_fn(pf, qual, fn, np_aliases))
+        return findings
+
+    def _check_fn(self, pf, qual, fn, np_aliases):
+        out = []
+
+        def finding(node, what):
+            out.append(Finding(
+                self.name, pf.relpath, node.lineno,
+                f"{what} in hot path {qual}() forces a host sync; move "
+                f"it off the per-step path, keep the value lazy, or "
+                f"annotate a deliberate sync with "
+                f"`# mxtpu-lint: host-sync-ok`"))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # x.item() — the canonical scalar sync
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                finding(node, f"`{ast.unparse(node.func)}()`")
+                continue
+            # x.block_until_ready() / jax.device_get(x) / x.tolist()
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("block_until_ready", "tolist"):
+                finding(node, f"`.{node.func.attr}()`")
+                continue
+            if name and name.endswith("device_get"):
+                finding(node, f"`{name}()`")
+                continue
+            # np.asarray/np.array on a (potential) device value
+            if name:
+                head, _, tail = name.rpartition(".")
+                if head in np_aliases and tail in ("asarray", "array"):
+                    finding(node, f"`{name}()`")
+                    continue
+            # float(x)/int(x) where x could be a device array
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ("float", "int") and \
+                    len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant):
+                    continue
+                if isinstance(arg, ast.Call) and \
+                        (call_name(arg) in _SAFE_CAST_CALLEES):
+                    continue
+                if _mentions_shape(arg):
+                    continue
+                finding(node, f"`{node.func.id}({ast.unparse(arg)[:40]})`")
+        return out

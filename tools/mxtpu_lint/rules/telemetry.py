@@ -1,0 +1,154 @@
+"""Rule: telemetry-coverage — the PR-7 gate, now living inside the
+shared analysis engine (``tools/check_telemetry_coverage.py`` remains
+as a thin CLI shim over this module).
+
+Every metric name, trace-event series, and ``mxtpu_xla_dispatch_total``
+site emitted anywhere in ``mxnet_tpu/`` must appear in the
+``docs/observability.md`` coverage map — a new instrumentation site
+cannot land undocumented, because the coverage map is what operators
+grep when an unknown series shows up on a dashboard.
+
+The module-level ``check()`` / ``collect_emitted()`` / ``main()``
+keep the original tool's exact contract (tests/test_telemetry_coverage
+imports them through the shim).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+from ..engine import Finding, Rule, register
+
+#: Prometheus-style metric names (the registry enforces this prefix by
+#: convention — every catalog entry starts mxtpu_)
+_METRIC_RE = re.compile(r'"(mxtpu_[a-z0-9_]+)"')
+
+#: trace-event series: tracer record()/instant()/span() first string
+#: argument. f-string names normalize to their literal prefix (e.g.
+#: ``cachedop.compile[{block}]`` -> ``cachedop.compile[``), matched as
+#: a substring of the docs.
+_TRACE_RE = re.compile(
+    r'\.(?:record|instant|span)\(\s*f?"([A-Za-z_][\w.\[\]{}]*)"')
+
+#: executable-dispatch site labels (mxtpu_xla_dispatch_total{site=...})
+_SITE_RE = re.compile(r'record_xla_dispatch\(\s*"([a-z0-9_]+)"')
+
+#: names that are not emitted series (helper strings the regexes also
+#: catch) — extend here, with a comment why, when a literal needs
+#: exempting.
+_IGNORE: set = {
+    # C ABI symbols of the custom-op library loader (library.py cdef),
+    # not telemetry series
+    "mxtpu_lib_num_ops", "mxtpu_lib_op_name", "mxtpu_lib_op_num_inputs",
+    "mxtpu_lib_op_infer_shape", "mxtpu_lib_op_compute",
+}
+
+DOCS_RELPATH = os.path.join("docs", "observability.md")
+
+
+def collect_emitted(pkg_dir):
+    """``{kind: {name: [files...]}}`` for every telemetry name emitted
+    under ``pkg_dir``."""
+    found = {"metric": {}, "trace": {}, "site": {}}
+    for dirpath, _dirnames, filenames in os.walk(pkg_dir):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            rel = os.path.relpath(path, os.path.dirname(pkg_dir))
+            for name in _METRIC_RE.findall(text):
+                if name not in _IGNORE:
+                    found["metric"].setdefault(name, []).append(rel)
+            for name in _TRACE_RE.findall(text):
+                name = name.split("{")[0]  # f-string -> literal prefix
+                if name and name not in _IGNORE:
+                    found["trace"].setdefault(name, []).append(rel)
+            for name in _SITE_RE.findall(text):
+                found["site"].setdefault(name, []).append(rel)
+    return found
+
+
+def check(root=None):
+    """Returns ``(missing, found)`` where missing is a list of
+    ``(kind, name, files)`` entries absent from docs/observability.md."""
+    root = root or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    pkg = os.path.join(root, "mxnet_tpu")
+    docs_path = os.path.join(root, DOCS_RELPATH)
+    with open(docs_path, encoding="utf-8") as f:
+        docs = f.read()
+    found = collect_emitted(pkg)
+    missing = []
+    for kind, names in found.items():
+        for name, files in sorted(names.items()):
+            if name not in docs:
+                missing.append((kind, name, sorted(set(files))))
+    return missing, found
+
+
+def _first_location(root, relfile, name):
+    """Line of the first occurrence of ``name`` in ``relfile`` (1 when
+    unlocatable — the finding still points at the right file)."""
+    try:
+        with open(os.path.join(root, relfile), encoding="utf-8") as f:
+            for i, line in enumerate(f, start=1):
+                if name in line:
+                    return i
+    except OSError:
+        pass
+    return 1
+
+
+@register
+class TelemetryCoverageRule(Rule):
+    name = "telemetry-coverage"
+    doc = ("every emitted metric/trace/dispatch-site name must appear "
+           "in the docs/observability.md coverage map")
+
+    def finalize(self, ctx):
+        try:
+            missing, _found = check(ctx.root)
+        except OSError as e:
+            return [Finding(self.name, DOCS_RELPATH.replace(os.sep, "/"),
+                            1, f"cannot run telemetry coverage: {e}")]
+        findings = []
+        for kind, name, files in missing:
+            file = files[0]
+            findings.append(Finding(
+                self.name, file.replace(os.sep, "/"),
+                _first_location(ctx.root, file, name),
+                f"[{kind}] `{name}` is emitted but missing from the "
+                f"docs/observability.md coverage map (also emitted in: "
+                f"{', '.join(files)}) — document it or exempt it with a "
+                f"comment in tools/mxtpu_lint/rules/telemetry.py::_IGNORE"))
+        return findings
+
+
+def main(argv=None):
+    """CLI entry preserved for tools/check_telemetry_coverage.py."""
+    ap = argparse.ArgumentParser(
+        description="check telemetry names against docs/observability.md")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this file's repo)")
+    args = ap.parse_args(argv)
+    missing, found = check(args.root)
+    n = sum(len(v) for v in found.values())
+    if not missing:
+        print(f"telemetry coverage OK: {n} emitted names all documented "
+              "in docs/observability.md")
+        return 0
+    print(f"telemetry coverage FAILED: {len(missing)} of {n} emitted "
+          "names missing from docs/observability.md:", file=sys.stderr)
+    for kind, name, files in missing:
+        print(f"  [{kind}] {name}  (emitted in {', '.join(files)})",
+              file=sys.stderr)
+    print("document each name in the docs/observability.md coverage map "
+          "(metric catalog / tracer section), or exempt it with a "
+          "comment in tools/mxtpu_lint/rules/telemetry.py::_IGNORE",
+          file=sys.stderr)
+    return 1

@@ -1,0 +1,183 @@
+"""Rule: thread-guard.
+
+Bug class retired: the PR-8 ``flush()`` race — checkpoint pending-write
+accounting mutated off-lock let ``flush()`` return with a snapshot
+still queued (an Event observed an empty queue BETWEEN a producer's
+clear() and its put()). Background-thread state must be mutated only
+under its lock, and "which lock guards what" should be machine-readable
+rather than a comment.
+
+Declaration: a class (or module) declares its lock protocol in a
+``_GUARDED_BY`` map::
+
+    class CheckpointManager:
+        _GUARDED_BY = {"_pending": "_cv"}
+
+Every assignment / augmented assignment / deletion of a declared
+attribute outside a ``with self._cv:`` block (or ``with _LOCK:`` for
+module-level state) is a finding. ``__init__`` is exempt — construction
+happens before the state is shared.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, dotted_name, register
+
+
+def _guarded_map(body):
+    """Extract ``_GUARDED_BY = {"attr": "lock"}`` from a class/module
+    body; returns {} when absent or not a plain dict literal."""
+    for node in body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_GUARDED_BY" and \
+                isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(v, ast.Constant):
+                    out[str(k.value)] = str(v.value)
+            return out
+    return {}
+
+
+def _mutated_attr(node, selfname):
+    """-> attribute name when ``node`` mutates ``self.<attr>`` or
+    ``self.<attr>[...]`` (Assign/AugAssign target or Del)."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    for t in targets:
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == selfname:
+            yield base.attr
+
+
+def _mutated_names(node):
+    """Module-level form: plain-name / name-subscript mutations."""
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = node.targets
+    for t in targets:
+        base = t
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        if isinstance(base, ast.Name):
+            yield base.id
+
+
+def _with_locks(stack, selfname):
+    """Lock attribute/names held by the enclosing ``with`` stack."""
+    held = set()
+    for w in stack:
+        for item in w.items:
+            expr = item.context_expr
+            # with self._lock: / with self._cv:
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == selfname:
+                held.add(expr.attr)
+            elif isinstance(expr, ast.Name):
+                held.add(expr.id)
+            else:
+                d = dotted_name(expr)
+                if d:
+                    held.add(d.rsplit(".", 1)[-1])
+    return held
+
+
+@register
+class ThreadGuardRule(Rule):
+    name = "thread-guard"
+    doc = ("attributes declared in a _GUARDED_BY map may only be "
+           "mutated under their declared lock")
+
+    def check_file(self, pf, ctx):
+        findings = []
+        # module-level declaration governs module functions
+        mod_guard = _guarded_map(pf.tree.body)
+        for node in ast.iter_child_nodes(pf.tree):
+            if isinstance(node, ast.ClassDef):
+                guard = _guarded_map(node.body)
+                if guard:
+                    findings.extend(
+                        self._check_class(pf, node, guard))
+        if mod_guard:
+            findings.extend(self._check_module(pf, mod_guard))
+        return findings
+
+    def _check_class(self, pf, cls, guard):
+        findings = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__":
+                continue  # construction precedes sharing
+            selfname = meth.args.args[0].arg if meth.args.args else None
+            if selfname is None:
+                continue
+            findings.extend(self._scan(pf, meth, guard,
+                                       f"{cls.name}.{meth.name}",
+                                       selfname))
+        return findings
+
+    def _check_module(self, pf, guard):
+        findings = []
+        for node in ast.iter_child_nodes(pf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._scan(pf, node, guard, node.name,
+                                           None))
+        return findings
+
+    def _scan(self, pf, fn, guard, where, selfname):
+        findings = []
+
+        def walk(node, with_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    # a closure/callback runs LATER — locks held at its
+                    # definition site are NOT held when it executes (the
+                    # PR-8 race lived in exactly this shape), so its body
+                    # is checked with an empty lock stack
+                    walk(child, [])
+                    continue
+                if isinstance(child, (ast.ClassDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.With):
+                    walk(child, with_stack + [child])
+                    continue
+                attrs = (_mutated_attr(child, selfname)
+                         if selfname is not None
+                         else _mutated_names(child))
+                for attr in attrs:
+                    lock = guard.get(attr)
+                    if lock is None:
+                        continue
+                    held = _with_locks(with_stack, selfname)
+                    if lock not in held:
+                        findings.append(Finding(
+                            self.name, pf.relpath, child.lineno,
+                            f"`{attr}` (declared _GUARDED_BY "
+                            f"`{lock}`) is mutated in {where}() "
+                            f"without holding `{lock}` — wrap the "
+                            f"mutation in `with "
+                            f"{'self.' if selfname else ''}{lock}:`"))
+                walk(child, with_stack)
+        walk(fn, [])
+        return findings
