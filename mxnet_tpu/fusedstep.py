@@ -114,6 +114,63 @@ def set_superstep_k(k: int) -> int:
     return prev
 
 
+_OVERLAP_MODES = ("ready", "barrier", "staged")
+
+
+def overlap_mode() -> str:
+    """Gradient-communication scheduling for the mesh train step
+    (``MXTPU_OVERLAP``): ``ready`` (default, and what ``1`` means) —
+    per-bucket allreduce issued inside the compiled step as soon as the
+    bucket's last contributing gradient exists (readiness order from
+    the VJP structure; XLA's latency-hiding scheduler overlaps the
+    collectives with the remaining backward compute); ``barrier`` (or
+    ``0``) — same single executable, but an optimization barrier holds
+    every collective until the whole backward finished (the parity/
+    ablation baseline); ``staged`` — the legacy host-driven
+    architecture: backward dispatch, then bucket-allreduce dispatch,
+    then update dispatch (comm fully exposed; kept for measurement).
+    Unknown values fall back to ``ready`` with one loud warning."""
+    v = str(getenv("MXTPU_OVERLAP", "ready", dtype=str) or "ready").lower()
+    v = {"1": "ready", "true": "ready", "on": "ready",
+         "0": "barrier", "false": "barrier", "off": "barrier"}.get(v, v)
+    if v not in _OVERLAP_MODES:
+        key = ("fusedstep", f"MXTPU_OVERLAP={v!r}")
+        if key not in _LOGGED:
+            _LOGGED.add(key)
+            _logger.warning("MXTPU_OVERLAP=%r is not one of %s; using "
+                            "'ready'", v, _OVERLAP_MODES)
+        return "ready"
+    return v
+
+
+def overlap_bucket_bytes() -> int:
+    """Target bucket payload for the in-graph overlapped allreduce
+    (``MXTPU_OVERLAP_BUCKET_BYTES``; defaults to ``MXTPU_BUCKET_BYTES``
+    so the in-graph and kvstore bucket plans agree unless tuned apart —
+    smaller buckets start communicating earlier, larger ones amortize
+    per-collective latency better)."""
+    v = getenv("MXTPU_OVERLAP_BUCKET_BYTES", None, dtype=int)
+    return int(v) if v else bucket_bytes()
+
+
+def zero_stage() -> int:
+    """Default ZeRO sharding stage for ``SPMDTrainStep``
+    (``MXTPU_ZERO_STAGE``, default 0): 0 = replicated optimizer state,
+    1 = sharded optimizer state (GSPMD sharding constraints, the
+    legacy ``shard_opt_states=True``), 2 = reduce-scattered gradients +
+    flat-sharded optimizer state + allgathered updated params, 3 =
+    params sharded at rest too, allgathered just-in-time inside the
+    step. See docs/performance.md "scale-out"."""
+    s = int(getenv("MXTPU_ZERO_STAGE", 0, dtype=int))
+    if s not in (0, 1, 2, 3):
+        key = ("fusedstep", f"MXTPU_ZERO_STAGE={s}")
+        if key not in _LOGGED:
+            _LOGGED.add(key)
+            _logger.warning("MXTPU_ZERO_STAGE=%s is not 0-3; using 0", s)
+        return 0
+    return s
+
+
 _RETRACE_BUDGET_DEFAULT = 8
 
 

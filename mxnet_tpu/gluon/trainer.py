@@ -962,9 +962,9 @@ class Superstep:
     :class:`~mxnet_tpu.gluon.data.prefetcher.SuperstepRing`. The host
     touches the loop once per K steps: it reads lazy telemetry gauges,
     applies the in-graph loss-scale backoff/growth results back to the
-    scaler, and advances the lr scheduler (the K iterations of one
-    dispatch share the lr the first of them would have seen — per-step
-    scheduling inside a superstep has K-step granularity).
+    scaler, and samples the lr scheduler once per covered update count
+    (a [K] lr vector rides the scan operands, so per-iteration
+    schedules apply at exactly the single-step loop's cadence).
 
     >>> sstep = gluon.Superstep(net, loss_fn, trainer, k=8)
     >>> for group, n in gluon.data.SuperstepRing(loader, 8, device=ctx):
@@ -1090,10 +1090,15 @@ class Superstep:
                 _TRACE_STATE.active = False
 
         def superstep_fn(params, sts, scale, unsk, ovf, xs, ys, keys,
-                         lr, wd, rescale, clip, lr_mults, wd_mults):
+                         lrs, wd, rescale, clip, lr_mults, wd_mults):
+            # ``lrs`` is a [K] vector: iteration i applies lrs[i] — the
+            # scheduler is sampled PER SCAN ITERATION on the host (K
+            # cheap pure-function calls), so lr cadence inside a
+            # superstep matches the single-step loop exactly instead of
+            # freezing at K-step granularity
             def body(carry, slot):
                 params, sts, scale, unsk, ovf = carry
-                x, y, key = slot
+                x, y, key, lr = slot
 
                 def loss_of(dp):
                     full = list(params)
@@ -1140,7 +1145,7 @@ class Superstep:
 
             (params, sts, scale, unsk, ovf), (losses, gnorms, it_ovfs) = \
                 jax.lax.scan(body, (params, sts, scale, unsk, ovf),
-                             (xs, ys, keys))
+                             (xs, ys, keys, lrs))
             return params, sts, scale, unsk, ovf, losses, gnorms, it_ovfs
 
         fn = jax.jit(superstep_fn,
@@ -1243,8 +1248,10 @@ class Superstep:
         o = tr._optimizer
         scaler = getattr(tr, "_amp_loss_scaler", None)
         # host bookkeeping, once per K steps: update counts advance by
-        # K; the scheduler is sampled at the FIRST iteration's count
-        # (within a superstep lr is constant — K-step granularity)
+        # K; the scheduler is sampled PER ITERATION — scan slot i rides
+        # lr(first_update + i), exactly the count the single-step loop
+        # would have used (K pure host calls; the [K] lr vector is an
+        # operand, so a schedule change never retraces)
         first_update = None
         prev_num_update = o.num_update
         for ix in plan["idx"]:
@@ -1255,9 +1262,9 @@ class Superstep:
                 else max(first_update, c - k + 1)
         o.rescale_grad = tr._scale / batch_size
         if o.lr_scheduler is not None:
-            lr_val = o.lr_scheduler(first_update)
+            lr_vals = [o.lr_scheduler(first_update + i) for i in range(k)]
         else:
-            lr_val = o.learning_rate
+            lr_vals = [o.learning_rate] * k
         mults = tuple((p.lr_mult, p.wd_mult)
                       for i, (_, p) in enumerate(plan["items"])
                       if plan["diff"][i])
@@ -1267,7 +1274,7 @@ class Superstep:
                                            jnp.float32)
             plan["wd_mults"] = jnp.asarray([m[1] for m in mults],
                                            jnp.float32)
-        lr = jnp.asarray(lr_val, jnp.float32)
+        lr = jnp.asarray(lr_vals, jnp.float32)
         wd = jnp.asarray(o.wd, jnp.float32)
         rescale = jnp.asarray(o.rescale_grad, jnp.float32)
         clip = jnp.asarray(o.clip_gradient if plan["has_clip"] else 0.0,

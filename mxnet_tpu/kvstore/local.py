@@ -63,6 +63,7 @@ class KVStoreLocal(KVStoreBase):
         self._optimizer = None
         self._opt_states = {}
         self._bucket_plans = {}  # signature -> compiled bucket round-trip
+        self._bucket_residuals = {}  # signature -> 2-bit residual carry
 
     def _key(self, key):
         return str(key)
@@ -143,12 +144,16 @@ class KVStoreLocal(KVStoreBase):
         WITHOUT touching the stored weight (Trainer's allreduce path)."""
         if isinstance(key, (list, tuple)):
             eligible = (out is not None and self._updater is None
-                        and self._optimizer is None
-                        and getattr(self, "_compression", None) is None)
+                        and self._optimizer is None)
+            # 2-bit compression rides the BUCKETED path (per-bucket
+            # quantize + residual carry compiled into the pack, before
+            # the wire reduction) — only the grouped/per-key fallbacks
+            # still do it per key
             if eligible and _fusedstep.ENABLED \
                     and self._bucketed_pushpull(key, value, out):
                 return
-            if eligible and self._grouped_pushpull(key, value, out):
+            if eligible and getattr(self, "_compression", None) is None \
+                    and self._grouped_pushpull(key, value, out):
                 return
             for i, k in enumerate(key):
                 self.pushpull(k, value[i], out=None if out is None else out[i],
@@ -248,13 +253,18 @@ class KVStoreLocal(KVStoreBase):
             _fusedstep.log_fallback(
                 "kvstore", "sparse gradients use the per-key path")
             return False
+        compress = getattr(self, "_compression", None)
+        thr = compress["threshold"] if compress else None
         if self._reduce_raw_is_identity() \
-                and all(len(vs) == 1 for vs in raw_groups):
+                and all(len(vs) == 1 for vs in raw_groups) \
+                and thr is None:
             # single device, nothing to reduce (in-process store, or a
             # dist store running one process): pure identity — the
             # grouped path short-circuits to a no-op, so a bucket
             # pack/unpack round-trip would only ADD a dispatch and a
-            # full-gradient-set copy per step
+            # full-gradient-set copy per step. (With compression there
+            # IS in-graph work — quantize + residual — so that case
+            # stays on the bucketed path.)
             return False
         groups = raw_groups  # raw jax arrays: shape/dtype/nbytes below
         # reduced-precision wire format only matters when a real
@@ -263,30 +273,42 @@ class KVStoreLocal(KVStoreBase):
             else _fusedstep.amp_allreduce_dtype()
         key_sig = tuple((tuple(vs[0].shape), str(vs[0].dtype), len(vs))
                         for vs in groups)
-        sig = (comm,) + key_sig
+        sig = (comm, thr) + key_sig
         plan = self._bucket_plans.get(sig)
         if plan is None:
-            plan = self._build_bucket_plan(key_sig, comm)
+            plan = self._build_bucket_plan(key_sig, comm, compress=thr)
             self._bucket_plans[sig] = plan
             if _obs.ENABLED:
                 _obs.KV_BUCKET_BUILD_TOTAL.inc()
+                _obs.OVERLAP_BUCKETS.set(len(plan["buckets"]),
+                                         site="kvstore")
+        # per-bucket error-feedback carry, keyed by the SAME signature
+        # the plan is (a shape/dtype change restarts the carry — the
+        # residual layout is a function of the plan)
+        res = self._bucket_residuals.get(sig, ()) if thr is not None \
+            else ()
+        if thr is not None and not res:
+            res = tuple(jnp.zeros((n,), jnp.dtype(dt))
+                        for n, dt in plan["res_shapes"])
 
         intro = _obs.introspect
         if plan["fused"] is not None:
             if intro.ENABLED and not intro.registered("kv_bucket"):
                 intro.register_jit("kv_bucket", plan["fused"],
-                                   (intro.avals_of(raw_groups),))
+                                   (intro.avals_of(raw_groups),
+                                    intro.avals_of(res)))
             with intro.annotate("mxtpu.grad_bucket") if intro.PROFILING \
                     else _NULL_CTX:
-                merged = plan["fused"](raw_groups)
+                merged, new_res = plan["fused"](raw_groups, res)
             n_dispatch = 1
         else:
             if intro.ENABLED and not intro.registered("kv_bucket_pack"):
                 intro.register_jit("kv_bucket_pack", plan["pack"],
-                                   (intro.avals_of(raw_groups),))
+                                   (intro.avals_of(raw_groups),
+                                    intro.avals_of(res)))
             prof = intro.PROFILING
             with intro.annotate("mxtpu.grad_pack") if prof else _NULL_CTX:
-                bucket_arrs = plan["pack"](raw_groups)
+                bucket_arrs, new_res = plan["pack"](raw_groups, res)
             reduce_live = not self._reduce_raw_is_identity()
             with intro.annotate("mxtpu.grad_allreduce") if prof \
                     else _NULL_CTX:
@@ -295,6 +317,8 @@ class KVStoreLocal(KVStoreBase):
             with intro.annotate("mxtpu.grad_unpack") if prof else _NULL_CTX:
                 merged = plan["unpack"](bucket_arrs)
             n_dispatch = 2 + (len(bucket_arrs) if reduce_live else 0)
+        if thr is not None:
+            self._bucket_residuals[sig] = tuple(new_res)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("kv_bucket", n_dispatch)
             _obs.KV_BUCKET_PUSHPULL_TOTAL.inc()
@@ -314,40 +338,43 @@ class KVStoreLocal(KVStoreBase):
                 o._set_data(self._place(m, o))
         return True
 
-    def _build_bucket_plan(self, sig, comm=""):
-        """Greedy dtype-homogeneous packing of keys into ~bucket_bytes
-        flat buckets, plus the compiled pack/unpack for this signature.
+    def _build_bucket_plan(self, sig, comm="", compress=None):
+        """Readiness-ordered dtype-homogeneous packing of keys into
+        ~bucket_bytes flat buckets, plus the compiled pack/unpack for
+        this signature. The packing itself delegates to
+        :func:`parallel.overlap.build_bucket_plan` — ONE greedy
+        algorithm serves the in-graph overlapped step and this staged
+        store, composed in reverse key order (the trainer pushes keys
+        in parameter order and backward produces the LAST parameter's
+        gradient first, so bucket 0's reduction dispatch goes on the
+        wire while later buckets still pack — the kvstore-level shadow
+        of the in-graph bucket-ready schedule).
+
         ``comm`` (MXTPU_AMP_ALLREDUCE_DTYPE): non-empty casts float32
         buckets down to that dtype inside the compiled pack — half the
         wire bytes through ``_reduce_raw`` — and back to float32 inside
         the compiled unpack (the reduction itself accumulates in fp32,
-        see ``dist._accum_sum``). In-graph both ways: no extra
+        see ``dist._accum_sum``). ``compress``: 2-bit threshold —
+        per-bucket quantize with error-feedback residual INSIDE the
+        compiled pack, before the wire (the reference's worker-side
+        compress-then-push order). In-graph throughout: no extra
         dispatches, and ``_place`` still sees the storage dtype."""
-        target = max(_fusedstep.bucket_bytes(), 1)
+        from ..parallel import overlap as _overlap
+
         shapes = [s for s, _, _ in sig]
-        sizes = []
-        for shape, dtype, _ in sig:
-            n = 1
-            for d in shape:
-                n *= d
-            sizes.append(n)
-        buckets = []  # lists of key indices, concat order
-        bucket_dtypes = []  # storage dtype per bucket (dtype-homogeneous)
-        open_per_dtype = {}  # dtype -> (bucket list, running bytes)
-        for ki, (shape, dtype, _) in enumerate(sig):
-            nbytes = sizes[ki] * jnp.dtype(dtype).itemsize
-            idxs, filled = open_per_dtype.get(dtype, (None, 0))
-            if idxs is None or (filled and filled + nbytes > target):
-                idxs, filled = [], 0
-                buckets.append(idxs)
-                bucket_dtypes.append(dtype)
-            idxs.append(ki)
-            open_per_dtype[dtype] = (idxs, filled + nbytes)
+        dtypes = [dt for _, dt, _ in sig]
+        oplan = _overlap.build_bucket_plan(
+            shapes, dtypes, bucket_bytes=max(_fusedstep.bucket_bytes(), 1))
+        buckets = [list(b) for b in oplan.buckets]
+        sizes = list(oplan.sizes)
+        bucket_dtypes = [dtypes[idxs[0]] for idxs in buckets]
         # only fp32 buckets are downcast: half/low dtypes gain nothing
         cast_down = [bool(comm) and dt == "float32" for dt in bucket_dtypes]
+        res_shapes = [(sum(sizes[ki] for ki in idxs), bucket_dtypes[bi])
+                      for bi, idxs in enumerate(buckets)]
 
-        def pack(raw_groups):
-            out = []
+        def pack(raw_groups, residuals):
+            out, new_res = [], []
             for bi, idxs in enumerate(buckets):
                 parts = []
                 for ki in idxs:
@@ -357,10 +384,14 @@ class KVStoreLocal(KVStoreBase):
                         s = s + extra  # cross-device tree-sum per key
                     parts.append(s.reshape(-1))
                 b = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                if compress is not None:
+                    b, r = _overlap.compress_bucket(b, compress,
+                                                    residuals[bi])
+                    new_res.append(r)
                 if cast_down[bi]:
                     b = b.astype(jnp.dtype(comm))
                 out.append(b)
-            return tuple(out)
+            return tuple(out), tuple(new_res)
 
         def unpack(bucket_arrs):
             raws = [None] * len(sig)
@@ -379,11 +410,17 @@ class KVStoreLocal(KVStoreBase):
 
         if type(self)._reduce_raw is KVStoreLocal._reduce_raw:
             # in-process reduction is identity: the whole round-trip is
-            # ONE executable (pack, sum, scatter all fused by XLA)
-            return {"fused": jax.jit(lambda g: unpack(pack(g))),
-                    "pack": None, "unpack": None, "buckets": buckets}
+            # ONE executable (pack, quantize, sum, scatter all fused)
+            def fused(raw_groups, residuals):
+                bs, nr = pack(raw_groups, residuals)
+                return unpack(bs), nr
+
+            return {"fused": jax.jit(fused), "pack": None,
+                    "unpack": None, "buckets": buckets,
+                    "res_shapes": res_shapes}
         return {"fused": None, "pack": jax.jit(pack),
-                "unpack": jax.jit(unpack), "buckets": buckets}
+                "unpack": jax.jit(unpack), "buckets": buckets,
+                "res_shapes": res_shapes}
 
     def _reduce_raw(self, raw):
         """Cross-process reduction of one flat gradient bucket: identity
@@ -436,6 +473,7 @@ class KVStoreLocal(KVStoreBase):
             "threshold": float(compression_params.get("threshold", 0.5))
         }
         self._residuals = {}
+        self._bucket_residuals = {}  # threshold rides the plan signature
 
     def _compress(self, key, merged):
         if getattr(self, "_compression", None) is None:

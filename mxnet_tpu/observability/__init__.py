@@ -222,6 +222,39 @@ SUPERSTEP_STEP_SECONDS = _REGISTRY.histogram(
     "time the host observes (gauges update once per superstep, so "
     "per-step series have K-step cadence; docs/observability.md)")
 
+# -- scale-out: overlapped allreduce + ZeRO sharding (parallel/) ----------
+
+OVERLAP_BUCKETS = _REGISTRY.gauge(
+    "mxtpu_overlap_buckets",
+    "gradient buckets in the current bucket-ready comm plan, by site "
+    "(readiness-ordered ~MXTPU_OVERLAP_BUCKET_BYTES buckets; each is "
+    "one in-graph collective)")
+OVERLAP_EXPOSED_COMM_SECONDS = _REGISTRY.gauge(
+    "mxtpu_overlap_exposed_comm_seconds",
+    "per-step wall time NOT hidden behind compute, by comm mode "
+    "(step time minus the compute-only probe's; set by the overlap "
+    "measurement probe — bench.py overlap / measure_overlap)")
+OVERLAP_HIDDEN_FRACTION = _REGISTRY.gauge(
+    "mxtpu_overlap_hidden_fraction",
+    "fraction of the staged baseline's exposed comm time the "
+    "bucket-ready overlapped step hides (1 - exposed_ready/"
+    "exposed_staged, from the overlap measurement probe)")
+ZERO_STATE_BYTES = _REGISTRY.gauge(
+    "mxtpu_zero_state_bytes",
+    "per-device at-rest bytes of the SPMD step's state, by kind "
+    "(param / opt) — the ZeRO sharding saving vs a replicated layout "
+    "is visible as this gauge dropping ~1/dp at stage 2/3")
+
+
+def record_overlap_probe(exposed_by_mode, hidden_fraction):
+    """Publish an overlap measurement (exposed comm seconds per mode +
+    the hidden fraction) into the registry."""
+    for mode, sec in (exposed_by_mode or {}).items():
+        OVERLAP_EXPOSED_COMM_SECONDS.set(float(sec), mode=str(mode))
+    if hidden_fraction is not None:
+        OVERLAP_HIDDEN_FRACTION.set(float(hidden_fraction))
+
+
 AMP_LOSS_SCALE = _REGISTRY.gauge(
     "mxtpu_amp_loss_scale",
     "current dynamic loss scale (fp16 AMP); under the fused step this "

@@ -11,6 +11,10 @@ from .mesh import make_mesh, current_mesh, data_parallel_mesh  # noqa: F401
 from .spmd import (SPMDTrainStep, shard_batch, replicate,  # noqa: F401
                    bucketed_psum,  # noqa: F401
                    spmd_save_states, spmd_load_states)  # noqa: F401
+from . import overlap  # noqa: F401
+from .overlap import (BucketPlan, build_bucket_plan,  # noqa: F401
+                      bucket_allreduce, bucket_reduce_scatter,
+                      first_use_order, measure_overlap)
 from .ring_attention import ring_attention, shard_sequence  # noqa: F401
 from .pipeline import (PipelineTrainStep, pipeline_apply,  # noqa: F401,E402
                        shard_stages, stack_stage_params)

@@ -24,6 +24,7 @@ from . import checkpoint  # noqa: F401
 from . import resume  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
+    default_commit_barrier,
     latest_checkpoint,
     maybe_checkpointing,
     verify,

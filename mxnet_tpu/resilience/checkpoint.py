@@ -278,6 +278,39 @@ def _next_seq():
         return _TMP_SEQ[0]
 
 
+_COMMIT_BARRIER_SEQ = [0]
+
+
+def default_commit_barrier():
+    """The automated multi-host commit-coordination barrier: a callable
+    every rank invokes around the rank-0 manifest/commit of a sharded
+    checkpoint (``resume.save_spmd_checkpoint`` uses it whenever the
+    caller passes no explicit barrier on a multi-process mesh).
+
+    Single-process: a no-op. Multi-process: one
+    ``multihost_utils.sync_global_devices`` per call, under the same
+    loud watchdog timeout + no-retry-on-timeout discipline as
+    ``kvstore.barrier`` (``MXTPU_BARRIER_TIMEOUT_S``) — a preempted
+    peer turns into a diagnosable crash at the commit point, never an
+    indefinite hang with a half-staged checkpoint. Tags are
+    process-globally unique so nested/successive saves never alias."""
+    if jax.process_count() == 1:
+        return lambda: None
+
+    from ..kvstore.dist import _barrier_timeout_s, _call_with_timeout
+
+    def barrier():
+        from jax.experimental import multihost_utils
+
+        _COMMIT_BARRIER_SEQ[0] += 1
+        tag = f"mxtpu_ckpt_commit_{_COMMIT_BARRIER_SEQ[0]}"
+        _call_with_timeout(
+            lambda: multihost_utils.sync_global_devices(tag),
+            _barrier_timeout_s(), f"checkpoint commit barrier {tag!r}")
+
+    return barrier
+
+
 def atomic_replace(path, write_fn):
     """Crash-safe file replacement: ``write_fn(tmp_path)`` produces the
     content, which is fsynced and renamed over ``path`` — unique tmp

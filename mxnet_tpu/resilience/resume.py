@@ -266,11 +266,15 @@ def save_spmd_checkpoint(directory, spmd_step, step, reason="manual",
     checkpoint. Every process calls this with ``directory`` on a
     SHARED filesystem; each rank writes only its addressable shards
     (``spmd.shard<rank>.npz``) into a per-step staging dir, then —
-    after ``barrier()`` (pass ``kvstore.barrier`` on a pod; required
-    when ``process_count > 1``) — **rank 0 alone** manifests all shard
-    files with checksums and performs the atomic rename-commit. A
-    single process stages + commits directly. Returns the committed
-    path on rank 0 (and on a single process), None on other ranks."""
+    after a barrier — **rank 0 alone** manifests all shard files with
+    checksums and performs the atomic rename-commit, so a ZeRO-sharded
+    checkpoint commits EXACTLY ONCE however many ranks saved. The
+    barrier is automated: leave ``barrier=None`` and the
+    watchdog-guarded :func:`checkpoint.default_commit_barrier` is used
+    (pass ``kvstore.barrier`` to ride an existing barrier sequence
+    instead). A single process stages + commits directly. Returns the
+    committed path on rank 0 (and on a single process), None on other
+    ranks."""
     import jax as _jax
 
     if spmd_step._state is None:
@@ -300,10 +304,11 @@ def save_spmd_checkpoint(directory, spmd_step, step, reason="manual",
     # commits would clobber each other leaving a manifest that lists
     # only the last committer's shard
     if barrier is None:
-        raise MXNetError(
-            "save_spmd_checkpoint on a multi-process mesh needs a "
-            "barrier callable (pass kvstore.barrier): rank 0 must not "
-            "commit before every rank's shard file is staged")
+        # automated commit coordination (ROADMAP item 4 remainder):
+        # rank 0 must not commit before every rank's shard is staged,
+        # and no rank may exit before the commit landed — previously
+        # documented as the caller's job, now the default
+        barrier = _ckpt.default_commit_barrier()
     staging = os.path.join(str(directory),
                            f".shards-{_ckpt._step_dirname(step)}")
     os.makedirs(staging, exist_ok=True)

@@ -78,4 +78,29 @@ step2.load_states(prefix)
 loss_resume = step2(x, y, lr=0.05)
 assert abs(loss_cont - loss_resume) < 1e-6, (loss_cont, loss_resume)
 
+# automated multi-host commit coordination: save_spmd_checkpoint with
+# NO explicit barrier — default_commit_barrier stages every rank's
+# shard, rank 0 alone manifests + commits (exactly once), and every
+# rank can restore the committed checkpoint afterwards
+from mxnet_tpu import resilience
+from mxnet_tpu.resilience import checkpoint as _ckptmod
+
+auto_dir = os.path.join(ckpt_dir, "auto")
+out = resilience.save_spmd_checkpoint(auto_dir, step2, step=5)
+if rank == 0:
+    assert out is not None, "rank 0 must return the committed path"
+else:
+    assert out is None, f"rank {rank} must not commit"
+committed = _ckptmod._committed_steps(auto_dir)
+assert committed == [5], committed  # exactly one commit
+assert resilience.verify(os.path.join(auto_dir, "step_0000000005")) == []
+loss_c2 = step2(x, y, lr=0.05)
+
+step3 = parallel.SPMDTrainStep(net, gluon.loss.L2Loss(), "adam", {},
+                               mesh=mesh, shard_opt_states=True)
+step3.init_state()
+resilience.load_checkpoint(auto_dir, spmd_step=step3)
+loss_r2 = step3(x, y, lr=0.05)
+assert abs(loss_c2 - loss_r2) < 1e-6, (loss_c2, loss_r2)
+
 print(f"CKPT_WORKER_OK rank={rank}/{n}", flush=True)

@@ -1,0 +1,30 @@
+"""Seeded violations for the overlap-window-sync rule (the clean twin
+is overlap_clean.py). Never imported — parsed by mxtpu-lint."""
+
+import numpy as np
+
+import jax
+from mxnet_tpu import engine
+
+
+def issue_buckets(grads, axis, log):  # mxtpu-lint: overlap-window
+    flat = [g.reshape(-1) for g in grads]
+    # violation: graph-level barrier pins comm behind the whole backward
+    flat = jax.lax.optimization_barrier(tuple(flat))
+    out = []
+    for b in flat:
+        red = jax.lax.psum(b, axis)
+        log.append(float(red[0]))      # violation: float() host sync
+        out.append(red)
+    host = np.asarray(out[0])          # violation: host materialization
+    return out, host
+
+
+def staged_window(kv, buckets):  # mxtpu-lint: overlap-window
+    reduced = []
+    for b in buckets:
+        kv.barrier()                   # violation: host-level barrier
+        reduced.append(kv._reduce_raw(b))
+    engine.wait(reduced[0])            # violation: host-level barrier
+    reduced[0].block_until_ready()     # violation: host sync
+    return reduced

@@ -32,6 +32,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR5_OUT"] = str(tmp_path / "BENCH_pr5.json")
     env["BENCH_PR6_OUT"] = str(tmp_path / "BENCH_pr6.json")
     env["BENCH_PR8_OUT"] = str(tmp_path / "BENCH_pr8.json")
+    env["BENCH_PR10_OUT"] = str(tmp_path / "BENCH_pr10.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -84,6 +85,29 @@ def _rerun_checkpoint_probe(env):
     return _ckpt_rec(recs), res
 
 
+def _overlap_rec(recs):
+    ov = [r for r in recs if r["metric"].startswith("overlap_ready")]
+    return ov[0] if ov else None
+
+
+def _rerun_overlap_probe(env):
+    """A zero/negative comm-hidden fraction during the full run is
+    almost always host pressure (the probe times four compiled legs on
+    a shared core), not a scheduling regression — re-run JUST the
+    overlap scenario in a clean subprocess once before failing (same
+    policy as the warm-cache and checkpoint probes)."""
+    env2 = dict(env)
+    env2["BENCH_ONLY"] = "overlap"
+    env2["BENCH_PR10_OUT"] = env["BENCH_PR10_OUT"] + ".retry"
+    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
+    res = subprocess.run(
+        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
+        env=env2, capture_output=True, text=True, timeout=600)
+    recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
+            if ln.startswith("{")]
+    return _overlap_rec(recs), res
+
+
 def test_bench_emits_driver_contract(tmp_path):
     env = _smoke_env(tmp_path)
     res = subprocess.run(
@@ -134,6 +158,24 @@ def test_bench_emits_driver_contract(tmp_path):
         ck, res2 = _rerun_checkpoint_probe(env)
         assert ck and ck["overhead_pct"] < 5.0, \
             (ck, res.stderr[-1000:], res2.stderr[-1000:])
+    # overlapped-allreduce scenario (PR10): the bucket-ready schedule
+    # must hide a positive fraction of the staged baseline's exposed
+    # comm, and the ZeRO-2/3 rows must show per-rank optimizer+gradient
+    # memory reduced ~ (N-1)/N at a parity loss trajectory
+    ov = _overlap_rec(recs)
+    assert ov, names
+    if not (ov.get("comm_hidden_fraction") or 0) > 0:
+        ov, res2 = _rerun_overlap_probe(env)
+        assert ov and (ov.get("comm_hidden_fraction") or 0) > 0, \
+            (ov, res.stderr[-1000:], res2.stderr[-1000:])
+    for stage in ("2", "3"):
+        zr = [r for r in recs
+              if r["metric"].startswith(f"zero{stage}_optgrad_mem")]
+        assert zr, names
+        assert zr[0]["value"] >= zr[0]["target_fraction"] - 0.01, zr
+        assert zr[0]["loss_max_diff_vs_zero0"] < 1e-5, zr
+    pr10 = json.load(open(tmp_path / "BENCH_pr10.json"))
+    assert pr10["scenario"] == "overlap" and "zero" in pr10, pr10
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs

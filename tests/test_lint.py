@@ -64,7 +64,8 @@ def test_rule_catalog_complete():
     assert len(REGISTRY) >= 5, sorted(REGISTRY)
     for required in ("host-sync-in-hot-path", "donation-after-use",
                      "capture-unsafe-in-graph", "env-var-discipline",
-                     "thread-guard", "telemetry-coverage"):
+                     "thread-guard", "telemetry-coverage",
+                     "overlap-window-sync"):
         assert required in REGISTRY
 
 
@@ -78,6 +79,7 @@ CASES = [
     ("capture-unsafe-in-graph", "capture_bad.py", 8, "capture_clean.py"),
     ("env-var-discipline", "env_bad.py", 3, "env_clean.py"),
     ("thread-guard", "guard_bad.py", 3, "guard_clean.py"),
+    ("overlap-window-sync", "overlap_bad.py", 6, "overlap_clean.py"),
 ]
 
 

@@ -1,0 +1,521 @@
+"""PR10 tentpole: bucket-ready overlapped allreduce + ZeRO-2/3.
+
+Overlap correctness — bit-identical params between barrier mode (comm
+pinned behind the whole backward) and ready mode (per-bucket collectives
+issued as gradients become available) for sgd/adam x multi-precision
+off/bf16 x K in {1, 4}; ZeRO-2/3 vs ZeRO-0 parity on the same plans;
+staged-mode (host-driven 3-dispatch baseline) agreement; the 2-bit
+compressed bucket path (allreduce == reduce-scatter flavor, kvstore
+bucket == per-key reference semantics); the ZeRO memory report; elastic
+ZeRO checkpoints across dp sizes; and the readiness-order / bucket-plan
+unit contracts. Runs on the 8-device virtual CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import fusedstep, gluon, parallel
+from mxnet_tpu.parallel import overlap as ovl
+from mxnet_tpu.parallel.spmd import spmd_load_states, spmd_save_states
+
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+_X = np.random.RandomState(0).rand(8, 10).astype(np.float32)
+_Y = np.random.RandomState(1).randint(0, 4, (8,)).astype(np.float32)
+_XS = np.stack([np.random.RandomState(10 + i).rand(8, 10).astype(np.float32)
+                for i in range(4)])
+_YS = np.stack([np.random.RandomState(20 + i).randint(0, 4, (8,))
+                .astype(np.float32) for i in range(4)])
+
+
+def _mesh(dp=4):
+    return parallel.make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+
+
+def _net(dtype="float32"):
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=10))
+    net.add(gluon.nn.Dense(4, in_units=16))
+    net.initialize(init=mx.initializer.Constant(0.0))
+    rng = np.random.RandomState(3)
+    for _, p in _psorted(net.collect_params().items()):
+        p.set_data(mx.nd.array(
+            rng.uniform(-0.3, 0.3, p.shape).astype(np.float32))
+            .astype(dtype))
+    return net
+
+
+def _psorted(items):
+    """Natural-sorted params: a plain name sort is lexicographic, so
+    when the process-global gluon layer counter straddles a digit
+    boundary (dense99 -> dense100) the layers swap and the rng draws
+    land on the wrong params — an ordering-dependent flake in a long
+    pytest session."""
+    import re
+
+    return sorted(items, key=lambda kv: [
+        int(s) if s.isdigit() else s
+        for s in re.split(r"(\d+)", kv[0])])
+
+
+def _weights(net):
+    return [np.asarray(p.data().data) for _, p in
+            _psorted(net.collect_params().items())]
+
+
+def _run(mode, k=1, opt="adam", stage=0, mp=False, comp=None, dp=4,
+         lr=0.05, n_groups=1):
+    mx.random.seed(42)
+    net = _net("bfloat16" if mp else "float32")
+    step = parallel.SPMDTrainStep(
+        net, loss_fn, opt, {"momentum": 0.9} if opt == "sgd" else {},
+        _mesh(dp), zero_stage=stage, overlap=mode, multi_precision=mp,
+        compression_params=comp)
+    losses = []
+    for _ in range(n_groups):
+        if k == 1:
+            for i in range(4):
+                losses.append(float(step(_XS[i], _YS[i], lr=lr)))
+        else:
+            out = step.run_superstep(_XS[:k], _YS[:k], lr=lr)
+            losses.extend(np.asarray(out, dtype=np.float32).tolist())
+    step.sync_to_block()
+    return losses, _weights(net), step
+
+
+# ---------------------------------------------------------------------------
+# overlap correctness: ready == barrier bit-identically
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [1, 4])
+@pytest.mark.parametrize("opt,mp", [
+    ("sgd", False), ("adam", False), ("sgd", True), ("adam", True),
+])
+def test_ready_matches_barrier_bitwise(opt, mp, k):
+    """The bucket-ready schedule changes WHEN collectives run, never
+    what they compute: params after barrier-mode and ready-mode runs
+    are bit-identical for sgd/adam x mp off/bf16 x K in {1,4}."""
+    lb, wb, _ = _run("barrier", k=k, opt=opt, mp=mp)
+    lr_, wr, _ = _run("ready", k=k, opt=opt, mp=mp)
+    assert lb == lr_, (lb, lr_)
+    for a, b in zip(wb, wr):
+        assert a.dtype == b.dtype
+        np.testing.assert_array_equal(a, b)
+
+
+def test_staged_with_compression_falls_to_barrier():
+    """staged is the UNCOMPRESSED measurement baseline: requesting
+    compression with it declines loudly to the in-graph barrier mode
+    (which carries the compressed path) instead of silently dropping
+    the compression."""
+    comp = {"type": "2bit", "threshold": 0.05}
+    _, wr, _ = _run("ready", comp=comp)
+    _, ws, st = _run("staged", comp=comp)
+    assert st._mode == "overlap" and st._overlap_mode == "barrier"
+    for a, b in zip(wr, ws):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_staged_matches_in_graph_modes():
+    """The host-driven 3-dispatch baseline computes the same step (it
+    exists to EXPOSE comm, not to change numerics)."""
+    _, wb, _ = _run("barrier")
+    _, ws, st = _run("staged")
+    assert st._mode == "staged"
+    for a, b in zip(wb, ws):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-2/3: same numbers, 1/dp the state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("k", [1, 4])
+def test_zero_stage_parity(stage, k):
+    """Reduce-scattered grads + flat-sharded opt state (+ sharded-at-
+    rest params at stage 3) produce bit-identical training to the
+    replicated stage-0 layout, one-step and inside the K-step scan."""
+    l0, w0, _ = _run("ready", k=k, stage=0)
+    ls, ws, _ = _run("ready", k=k, stage=stage)
+    assert l0 == ls, (l0, ls)
+    for a, b in zip(w0, ws):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_stage_parity_vs_zero1():
+    """ZeRO-1 (GSPMD constraint sharding, the jit path) agrees with the
+    ZeRO-2 shard_map layout."""
+    l1, w1, s1 = _run("ready", stage=1)
+    l2, w2, s2 = _run("ready", stage=2)
+    assert s1._mode == "jit" and s2._mode == "overlap"
+    np.testing.assert_allclose(l1, l2, rtol=1e-6, atol=1e-7)
+    for a, b in zip(w1, w2):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_zero_memory_report_reductions():
+    """Stage 2 cuts per-device optimizer+gradient bytes to ~1/dp of
+    replicated (scalar step counters stay replicated); stage 3 also
+    cuts at-rest param bytes for the trainable set."""
+    _, _, s0 = _run("ready", stage=0, n_groups=1)
+    _, _, s2 = _run("ready", stage=2, n_groups=1)
+    _, _, s3 = _run("ready", stage=3, n_groups=1)
+    r0, r2, r3 = (s.zero_memory_report() for s in (s0, s2, s3))
+    dp = r2["dp"]
+    assert dp == 4
+    # optimizer + gradient memory: >= (dp-1)/dp reduction modulo the
+    # replicated scalar counters (adam's t: a few bytes per param)
+    for rep in (r2, r3):
+        repl = rep["opt_bytes_replicated"] + rep["grad_bytes_replicated"]
+        dev = rep["opt_bytes_per_device"] + rep["grad_bytes_per_device"]
+        assert dev <= repl / dp * 1.05, rep
+    assert r0["opt_bytes_per_device"] == r0["opt_bytes_replicated"]
+    assert r3["param_bytes_per_device"] < r0["param_bytes_per_device"]
+
+
+# ---------------------------------------------------------------------------
+# 2-bit compression on the bucket plan
+# ---------------------------------------------------------------------------
+
+def test_compressed_buckets_allreduce_matches_reduce_scatter():
+    """The quantizer is elementwise, so the compressed allreduce (ZeRO
+    0) and compressed reduce-scatter (ZeRO 2) flavors train
+    identically — compression rides the overlapped path in both."""
+    comp = {"type": "2bit", "threshold": 0.05}
+    l0, w0, s0 = _run("ready", stage=0, comp=comp)
+    l2, w2, s2 = _run("ready", stage=2, comp=comp)
+    assert s0._residuals is not None and s2._residuals is not None
+    assert l0 == l2, (l0, l2)
+    for a, b in zip(w0, w2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_compression_error_feedback_changes_numerics_but_converges():
+    """The carry is real: compressed training differs from exact
+    training (quantized comm) but still reduces the loss."""
+    le, _, _ = _run("ready", n_groups=2)
+    lc, _, _ = _run("ready", comp={"type": "2bit", "threshold": 0.05},
+                    n_groups=2)
+    assert lc != le
+    assert lc[-1] < lc[0], lc
+
+
+def test_kvstore_compressed_bucketed_matches_per_key_reference():
+    """The kvstore's compressed bucketed pushpull (one compiled
+    pack+quantize+reduce+unpack) matches the reference per-key
+    merge -> quantize -> residual semantics across iterations."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.3})
+    rng = np.random.RandomState(0)
+    arrs = [rng.uniform(-1, 1, (64,)).astype(np.float32)
+            for _ in range(3)]
+    keys, vals, outs = [], [], []
+    for i, a in enumerate(arrs):
+        kv.init(i, mx.nd.zeros((64,)))
+        per_dev = []
+        for d in jax.devices()[:2]:
+            nd = mx.nd.array(a.copy())
+            nd._set_data(jax.device_put(nd.data, d))
+            per_dev.append(nd)
+        keys.append(i)
+        vals.append(per_dev)
+        outs.append(mx.nd.zeros((64,)))
+    thr = 0.3
+    res = [np.zeros_like(a) for a in arrs]
+    for it in range(3):
+        kv.pushpull(keys, vals, out=outs)
+        for i, a in enumerate(arrs):
+            acc = 2 * a + res[i]
+            q = np.where(acc >= thr, thr,
+                         np.where(acc <= -thr, -thr, 0.0)).astype(
+                             np.float32)
+            res[i] = acc - q
+            np.testing.assert_allclose(outs[i].asnumpy(), q,
+                                       rtol=1e-6, atol=1e-7)
+    assert len(kv._bucket_plans) == 1  # one compiled plan, reused
+
+
+def test_kvstore_compression_single_device_rides_bucketed_path():
+    """Quantization is in-graph work even with nothing to reduce: a
+    single-device compressed pushpull must take the bucketed path (the
+    old behavior fell all the way back to eager per-key dispatches)."""
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.zeros((8,)))
+    out = mx.nd.zeros((8,))
+    kv.pushpull([0], [[mx.nd.ones((8,))]], out=[out])
+    assert len(kv._bucket_plans) == 1
+    np.testing.assert_allclose(out.asnumpy(), np.full((8,), 0.5))
+
+
+# ---------------------------------------------------------------------------
+# elastic ZeRO checkpoints
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_checkpoint_elastic_restore(tmp_path, stage):
+    """A dp=4 ZeRO-sharded save (flat-padded shards, clipped to the
+    LOGICAL length) restores bit-exactly onto a dp=2 step — the pad is
+    layout, not state — and training continues identically."""
+    net = _net()
+    s4 = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(4),
+                                zero_stage=stage)
+    for i in range(2):
+        s4(_XS[i], _YS[i], lr=0.05)
+    s4.sync_to_block()
+    w_before = _weights(net)
+    prefix = str(tmp_path / "ck")
+    spmd_save_states(s4, prefix)
+    s2 = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(2),
+                                zero_stage=stage)
+    s2(_XS[0], _YS[0], lr=0.05)  # init + compile under the new layout
+    spmd_load_states(s2, prefix)
+    s2.sync_to_block()
+    for a, b in zip(w_before, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    la = float(s4(_XS[2], _YS[2], lr=0.05))
+    lb = float(s2(_XS[2], _YS[2], lr=0.05))
+    assert abs(la - lb) < 1e-5, (la, lb)
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero_checkpoint_restores_onto_single_device(tmp_path, stage):
+    """Elastic shrink all the way down: a dp=4 flat-sharded ZeRO save
+    restores bit-exactly onto a mesh-less single-device (jit-mode)
+    step whose params keep their natural shapes."""
+    net = _net()
+    s4 = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(4),
+                                zero_stage=stage)
+    for i in range(2):
+        s4(_XS[i], _YS[i], lr=0.05)
+    s4.sync_to_block()
+    w_before = _weights(net)
+    prefix = str(tmp_path / "ck")
+    spmd_save_states(s4, prefix)
+    s1 = parallel.SPMDTrainStep(net, loss_fn, "adam", {})
+    s1(_XS[0], _YS[0], lr=0.05)  # perturb; load must win
+    spmd_load_states(s1, prefix)
+    s1.sync_to_block()
+    for a, b in zip(w_before, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    la = float(s4(_XS[2], _YS[2], lr=0.05))
+    lb = float(s1(_XS[2], _YS[2], lr=0.05))
+    assert abs(la - lb) < 1e-5, (la, lb)
+
+
+def test_zero_checkpoint_stage_change_roundtrip(tmp_path):
+    """Stage changes across save/restore cross the flat<->natural
+    layout boundary in both directions: a stage-0 (natural) save loads
+    into a stage-2 (flat-sharded) step and vice versa, bit-exactly."""
+    net = _net()
+    s0 = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(4),
+                                zero_stage=0)
+    for i in range(2):
+        s0(_XS[i], _YS[i], lr=0.05)
+    s0.sync_to_block()
+    w_before = _weights(net)
+    p0 = str(tmp_path / "ck0")
+    spmd_save_states(s0, p0)
+    # natural -> flat
+    s2 = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(4),
+                                zero_stage=2)
+    s2(_XS[0], _YS[0], lr=0.05)
+    spmd_load_states(s2, p0)
+    s2.sync_to_block()
+    for a, b in zip(w_before, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    p2 = str(tmp_path / "ck2")
+    spmd_save_states(s2, p2)
+    # flat -> natural
+    s0b = parallel.SPMDTrainStep(net, loss_fn, "adam", {}, _mesh(4),
+                                 zero_stage=0)
+    s0b(_XS[0], _YS[0], lr=0.05)
+    spmd_load_states(s0b, p2)
+    s0b.sync_to_block()
+    for a, b in zip(w_before, _weights(net)):
+        np.testing.assert_array_equal(a, b)
+    la = float(s0(_XS[2], _YS[2], lr=0.05))
+    lb = float(s0b(_XS[2], _YS[2], lr=0.05))
+    assert abs(la - lb) < 1e-5, (la, lb)
+
+
+def test_zero_checkpoint_residuals_roundtrip(tmp_path):
+    """The 2-bit error-feedback carry is state: it round-trips through
+    the sharded checkpoint on an unchanged dp layout."""
+    comp = {"type": "2bit", "threshold": 0.05}
+    net = _net()
+    s = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                               zero_stage=2, compression_params=comp)
+    for i in range(2):
+        s(_XS[i], _YS[i], lr=0.05)
+    prefix = str(tmp_path / "ck")
+    spmd_save_states(s, prefix)
+    want = [np.asarray(r) for r in s._residuals]
+    assert any(np.abs(w).max() > 0 for w in want)  # carry is nonzero
+    s2 = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                                zero_stage=2, compression_params=comp)
+    s2(_XS[0], _YS[0], lr=0.05)
+    spmd_load_states(s2, prefix)
+    for w, g in zip(want, [np.asarray(r) for r in s2._residuals]):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_zero_checkpoint_residuals_restore_before_first_step(tmp_path):
+    """The normal resume path loads the checkpoint into a step that has
+    never compiled — the carry tensors don't exist yet. The saved carry
+    must be stashed and applied when _init_residuals runs at the first
+    step, not silently replaced with zeros."""
+    comp = {"type": "2bit", "threshold": 0.05}
+    net = _net()
+    s = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                               zero_stage=2, compression_params=comp)
+    for i in range(2):
+        s(_XS[i], _YS[i], lr=0.05)
+    prefix = str(tmp_path / "ck")
+    spmd_save_states(s, prefix)
+    want = [np.asarray(r) for r in s._residuals]
+    assert any(np.abs(w).max() > 0 for w in want)
+    # never-stepped step: residuals are deferred to the first compile
+    s2 = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                                zero_stage=2, compression_params=comp)
+    spmd_load_states(s2, prefix)
+    assert s2._residuals is None and s2._pending_residual_chunks
+    # post-compile restore (the roundtrip above) is the oracle: both
+    # steps must carry identical residual state into the next update
+    s3 = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                                zero_stage=2, compression_params=comp)
+    s3(_XS[0], _YS[0], lr=0.05)
+    spmd_load_states(s3, prefix)
+    l2 = float(s2(_XS[2], _YS[2], lr=0.05))
+    l3 = float(s3(_XS[2], _YS[2], lr=0.05))
+    assert s2._pending_residual_chunks is None
+    assert l2 == l3, (l2, l3)
+    for a, b in zip(s2._residuals, s3._residuals):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip([np.asarray(p) for p in s2._state[0]],
+                    [np.asarray(p) for p in s3._state[0]]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero_checkpoint_residuals_dp_shrink_restarts_carry(tmp_path, caplog):
+    """The carry's element layout is dp-interleaved, so a dp=4 save
+    must NOT restore onto a dp=2 step (the chunks that would reveal
+    the mismatch are span-filtered away — the guard compares the saved
+    GLOBAL extent instead): the carry keeps its current value and one
+    warning fires."""
+    import logging
+
+    comp = {"type": "2bit", "threshold": 0.05}
+    net = _net()
+    s = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                               zero_stage=2, compression_params=comp)
+    for i in range(2):
+        s(_XS[i], _YS[i], lr=0.05)
+    prefix = str(tmp_path / "ck")
+    spmd_save_states(s, prefix)
+    s2 = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(2),
+                                zero_stage=2, compression_params=comp)
+    s2(_XS[0], _YS[0], lr=0.05)
+    before = [np.asarray(r) for r in s2._residuals]
+    with caplog.at_level(logging.WARNING, "mxnet_tpu.parallel.spmd"):
+        spmd_load_states(s2, prefix)
+    assert any("error-feedback carry" in m for m in caplog.messages)
+    for w, g in zip(before, [np.asarray(r) for r in s2._residuals]):
+        np.testing.assert_array_equal(w, g)
+
+
+def test_grad_dtype_reduced_precision_wire():
+    """grad_dtype casts each bucket to the wire dtype for the
+    collective: fp32 (the native dtype) is a bitwise no-op, bf16
+    changes the summed gradients slightly but trains equivalently."""
+    def _run_wire(wire):
+        mx.random.seed(42)
+        net = _net()
+        step = parallel.SPMDTrainStep(net, loss_fn, "sgd", {}, _mesh(4),
+                                      overlap="ready", grad_dtype=wire)
+        losses = [float(step(_XS[i], _YS[i], lr=0.05)) for i in range(4)]
+        step.sync_to_block()
+        return losses, _weights(net)
+
+    l32, w32 = _run_wire(None)
+    lsame, wsame = _run_wire(np.float32)
+    assert l32 == lsame
+    for a, b in zip(w32, wsame):
+        np.testing.assert_array_equal(a, b)
+    lbf, wbf = _run_wire(jnp.bfloat16)
+    assert any(not np.array_equal(a, b) for a, b in zip(w32, wbf)), \
+        "bf16 wire dtype changed nothing — grad_dtype is a no-op"
+    for a, b in zip(w32, wbf):
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=0.02)
+
+
+# ---------------------------------------------------------------------------
+# plan/readiness unit contracts
+# ---------------------------------------------------------------------------
+
+def test_first_use_order_reflects_forward_order():
+    """Reverse-mode AD yields the LAST-used parameter's gradient first:
+    the readiness order must put later-used params earlier."""
+    def f(params, x):
+        h = x @ params[0]
+        h = h @ params[1]
+        return jnp.sum(h @ params[2])
+
+    avals = [jax.ShapeDtypeStruct((4, 4), jnp.float32)] * 3
+    order = ovl.first_use_order(
+        f, (avals, jax.ShapeDtypeStruct((2, 4), jnp.float32)), 3)
+    assert order == [2, 1, 0], order
+
+
+def test_bucket_plan_padding_and_homogeneity():
+    shapes = [(7,), (5,), (3, 3), (4,)]
+    dtypes = ["float32", "float16", "float32", "float32"]
+    plan = ovl.build_bucket_plan(shapes, dtypes, bucket_bytes=1 << 20,
+                                 dp=4)
+    for idxs in plan.buckets:
+        assert len({dtypes[i] for i in idxs}) == 1
+    # default order: reversed (the DDP heuristic)
+    assert plan.order == (3, 2, 1, 0)
+    for s, p in zip(plan.sizes, plan.pad_sizes):
+        assert p % 4 == 0 and p >= s
+
+
+def test_bucket_plan_splits_at_target_bytes():
+    shapes = [(1024,)] * 6
+    dtypes = ["float32"] * 6
+    plan = ovl.build_bucket_plan(shapes, dtypes, bucket_bytes=8192)
+    assert len(plan.buckets) == 3
+    assert all(len(b) == 2 for b in plan.buckets)
+
+
+def test_overlap_mode_env_knob(monkeypatch):
+    monkeypatch.setenv("MXTPU_OVERLAP", "barrier")
+    assert fusedstep.overlap_mode() == "barrier"
+    monkeypatch.setenv("MXTPU_OVERLAP", "1")
+    assert fusedstep.overlap_mode() == "ready"
+    monkeypatch.setenv("MXTPU_OVERLAP", "bogus")
+    assert fusedstep.overlap_mode() == "ready"  # warn-once fallback
+    monkeypatch.setenv("MXTPU_ZERO_STAGE", "2")
+    assert fusedstep.zero_stage() == 2
+    monkeypatch.setenv("MXTPU_ZERO_STAGE", "7")
+    assert fusedstep.zero_stage() == 0
+
+
+def test_measure_overlap_probe_publishes_metrics():
+    from mxnet_tpu import observability as obs
+
+    prev = obs.set_enabled(True)
+    try:
+        out = parallel.measure_overlap(
+            _net, loss_fn, "sgd", {}, _mesh(2), _X, _Y, lr=0.05,
+            steps=2, warmup=1, modes=("nocomm", "ready", "staged"))
+        assert set(out["exposed_comm_seconds"]) == {"ready", "staged"}
+        assert out["hidden_fraction"] is None or \
+            0.0 <= out["hidden_fraction"] <= 1.0
+    finally:
+        obs.set_enabled(prev)
+        obs.reset()

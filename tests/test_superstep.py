@@ -364,30 +364,66 @@ def test_superstep_adam_update_counts_advance_by_k():
     assert all(t == 4 for t in ts), ts
 
 
-def test_superstep_lr_scheduler_k_step_granularity():
-    """Within one superstep the K iterations share the FIRST iteration's
-    scheduled lr; the schedule advances between dispatches."""
-    seen = []
+class _StepDownSched(mx.lr_scheduler.LRScheduler):
+    """Probe schedule: records every sampled count, steps 0.1 -> 0.01
+    after update 2 — INSIDE the first K=4 superstep, so per-iteration
+    sampling is observable in the weights, not just the counts."""
 
-    class Probe(mx.lr_scheduler.LRScheduler):
-        def __call__(self, num_update):
-            seen.append(num_update)
-            return 0.1 if num_update <= 2 else 0.01
+    def __init__(self, seen):
+        super().__init__()
+        self.seen = seen
 
+    def __call__(self, num_update):
+        self.seen.append(num_update)
+        return 0.1 if num_update <= 2 else 0.01
+
+
+def _build_sched(seen):
     mx.random.seed(0)
+    np.random.seed(0)
     net = nn.Dense(3, in_units=8)
     net.initialize(init=mx.initializer.Xavier())
     net.hybridize()
     tr = gluon.Trainer(net.collect_params(), "sgd",
-                       {"learning_rate": 0.1, "lr_scheduler": Probe()},
+                       {"learning_rate": 0.1,
+                        "lr_scheduler": _StepDownSched(seen)},
                        kvstore=None)
+    return net, tr
+
+
+def test_superstep_lr_scheduler_per_iteration():
+    """ROADMAP item 5 remainder: the scheduler is sampled PER SCAN
+    ITERATION (counts first_update .. first_update+K-1 ride the scan as
+    a [K] lr vector), so a schedule boundary inside a superstep applies
+    at the right iteration — no more K-step lr granularity."""
+    seen = []
+    net, tr = _build_sched(seen)
     ss = gluon.Superstep(net, loss_fn, tr, k=2)
     for g in range(2):
         ss.step(stack_batches([_batch(g * 2 + i)[0] for i in range(2)]),
                 stack_batches([_batch(g * 2 + i)[1] for i in range(2)]),
                 16)
-    # sampled once per dispatch, at the first covered update count
-    assert seen == [1, 3], seen
+    # sampled once per iteration, at exactly the single-step counts
+    assert seen == [1, 2, 3, 4], seen
+
+
+def test_superstep_lr_schedule_parity_vs_single_step():
+    """A schedule stepping down mid-superstep produces bit-comparable
+    weights to the single-step loop over the same batches (the parity
+    pin for the per-iteration lr vector)."""
+    net_s, tr_s = _build_sched([])
+    for i in range(4):
+        x, y = _batch(i)
+        with autograd.record():
+            l = loss_fn(net_s(x), y)
+        l.backward()
+        tr_s.step(16)
+    net_k, tr_k = _build_sched([])
+    ss = gluon.Superstep(net_k, loss_fn, tr_k, k=4)
+    ss.step(stack_batches([_batch(i)[0] for i in range(4)]),
+            stack_batches([_batch(i)[1] for i in range(4)]), 16)
+    for a, b in zip(_weights(net_s), _weights(net_k)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
 # ---------------------------------------------------------------------------

@@ -46,6 +46,7 @@ _DIRECTIVE_RE = re.compile(r"#\s*mxtpu-lint:\s*([^#\n]+)")
 _ALIASES = {
     "host-sync-ok": "disable=host-sync-in-hot-path",
     "donation-ok": "disable=donation-after-use",
+    "overlap-barrier-ok": "disable=overlap-window-sync",
 }
 
 
@@ -89,6 +90,9 @@ class PyFile:
         self.file_suppressions = set()
         #: lines carrying a ``hot-path`` marker (host-sync rule opt-in)
         self.hot_lines = set()
+        #: lines carrying an ``overlap-window`` marker (overlap rule
+        #: opt-in — the def line of a function issuing bucket comm)
+        self.window_lines = set()
         self._index_directives()
 
     def _index_directives(self):
@@ -109,6 +113,8 @@ class PyFile:
                         .split(",") if r.strip())
                 elif part == "hot-path":
                     self.hot_lines.add(i)
+                elif part == "overlap-window":
+                    self.window_lines.add(i)
 
     def suppressed(self, finding: Finding) -> bool:
         if finding.rule in self.file_suppressions or \

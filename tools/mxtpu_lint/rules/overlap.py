@@ -1,0 +1,161 @@
+"""Rule: overlap-window-sync.
+
+Bug class retired: anything that re-serializes the bucket-ready
+overlapped allreduce (PR 10 tentpole). The overlap contract is that a
+gradient bucket's collective is ISSUED the moment its last contributing
+gradient exists and COMPLETES under later compute — so between bucket
+issue and last use there must be
+
+- no host synchronization (``.item()``, ``float()``, ``np.asarray``,
+  ``.block_until_ready()``, ``engine.wait`` — each pins the host to the
+  device stream and the hidden comm time becomes exposed again), and
+- no barrier: neither a cross-process ``barrier()`` /
+  ``sync_global_devices`` (host-level serialization) nor a stray
+  ``jax.lax.optimization_barrier`` (graph-level: it pins EVERY operand
+  behind every producer, which is exactly the ablation mode — correct
+  numerics, zero overlap).
+
+Window set = the built-in map below (the in-graph bucket collective
+helpers in ``parallel/overlap.py``, the ``SPMDTrainStep`` overlap
+builder, the scan-compatible ``bucketed_psum``, and the kvstore's
+bucketed pushpull pack→reduce→unpack span) plus any function whose
+``def`` line carries ``# mxtpu-lint: overlap-window``. The ONE
+legitimate ``optimization_barrier`` site — the ``barrier``-mode
+ablation helper — carries ``# mxtpu-lint: overlap-barrier-ok``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+
+from ..engine import (Finding, Rule, call_name, module_aliases,
+                      func_qualnames, register)
+
+#: (relpath glob, qualname glob) -> the overlap-window function bodies.
+WINDOW_FUNCTIONS = [
+    # the in-graph bucket collectives (issued inside the compiled step)
+    ("mxnet_tpu/parallel/overlap.py", "bucket_allreduce"),
+    ("mxnet_tpu/parallel/overlap.py", "bucket_reduce_scatter"),
+    ("mxnet_tpu/parallel/overlap.py", "compress_bucket"),
+    ("mxnet_tpu/parallel/overlap.py", "_maybe_barrier"),
+    ("mxnet_tpu/parallel/overlap.py", "shard_of"),
+    ("mxnet_tpu/parallel/overlap.py", "gather_shard"),
+    # the overlapped one-executable step builder (+ its traced body)
+    ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep._build_overlap"),
+    ("mxnet_tpu/parallel/spmd.py", "bucketed_psum"),
+    # the kvstore bucketed span: pack -> per-bucket reduce -> unpack
+    ("mxnet_tpu/kvstore/local.py", "KVStoreLocal._bucketed_pushpull"),
+    ("mxnet_tpu/kvstore/local.py", "KVStoreLocal._build_bucket_plan"),
+]
+
+#: host-materialization attributes (each blocks on the device stream)
+_SYNC_ATTRS = ("item", "tolist", "block_until_ready")
+
+#: callee tails that are a barrier between issue and last use
+_BARRIER_TAILS = ("barrier", "sync_global_devices", "wait")
+
+
+def _mentions_shape(node):
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and n.attr in (
+                "shape", "ndim", "size", "dtype", "itemsize"):
+            return True
+    return False
+
+
+@register
+class OverlapWindowRule(Rule):
+    name = "overlap-window-sync"
+    doc = ("no host sync or barrier (host barrier() or stray "
+           "optimization_barrier) between bucket issue and last use "
+           "inside the overlapped-comm window")
+
+    def check_file(self, pf, ctx):
+        pats = [q for g, q in WINDOW_FUNCTIONS
+                if fnmatch.fnmatch(pf.relpath, g)]
+        window = []
+        for qual, fn in func_qualnames(pf.tree):
+            if any(fnmatch.fnmatch(qual, p) for p in pats) or \
+                    fn.lineno in pf.window_lines:
+                window.append((qual, fn))
+        if not window:
+            return []
+        np_aliases = module_aliases(pf.tree, "numpy")
+        findings = []
+        seen = set()  # a nested def inside a window fn analyzed once
+        for qual, fn in window:
+            if id(fn) in seen:
+                continue
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    seen.add(id(sub))
+            findings.extend(self._check_fn(pf, qual, fn, np_aliases))
+        return findings
+
+    def _check_fn(self, pf, qual, fn, np_aliases):
+        out = []
+
+        def finding(node, what, why):
+            out.append(Finding(
+                self.name, pf.relpath, node.lineno,
+                f"{what} inside the overlap window {qual}() {why} — "
+                f"the bucket collective can no longer hide behind "
+                f"compute; move it outside the window (or annotate the "
+                f"barrier-mode ablation site with "
+                f"`# mxtpu-lint: overlap-barrier-ok`)"))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_ATTRS and not node.args:
+                finding(node, f"`.{node.func.attr}()`",
+                        "forces a host sync")
+                continue
+            if name and name.endswith("device_get"):
+                finding(node, f"`{name}()`", "forces a host sync")
+                continue
+            if name:
+                head, _, tail = name.rpartition(".")
+                if head in np_aliases and tail in ("asarray", "array"):
+                    finding(node, f"`{name}()`",
+                            "materializes a device value on the host")
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                if last == "optimization_barrier":
+                    finding(node, f"`{name}(...)`",
+                            "pins every collective behind the whole "
+                            "backward (graph-level barrier)")
+                    continue
+                if last in _BARRIER_TAILS and not node.args or \
+                        last == "sync_global_devices":
+                    # barrier()/kv.barrier()/engine.wait(x)/sync_...
+                    if last == "wait" and not (
+                            head.endswith("engine") or head == "engine"):
+                        pass  # an unrelated .wait() (threading) — skip
+                    else:
+                        finding(node, f"`{name}(...)`",
+                                "is a host-level barrier")
+                        continue
+                if last == "wait" and (head.endswith("engine")
+                                       or head == "engine"):
+                    finding(node, f"`{name}(...)`",
+                            "is a host-level barrier")
+                    continue
+            # float(x) on a potential device value (int() stays legal:
+            # the window code casts host-side plan/config integers —
+            # bucket sizes, dp — which never touch the device stream)
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id == "float" and \
+                    len(node.args) == 1:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) or _mentions_shape(arg):
+                    continue
+                if isinstance(arg, ast.Call) and call_name(arg) in (
+                        "len", "round", "min", "max", "sum", "getenv"):
+                    continue
+                finding(node, f"`{node.func.id}({ast.unparse(arg)[:40]})`",
+                        "forces a host sync")
+        return out
