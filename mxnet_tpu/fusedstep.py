@@ -171,6 +171,17 @@ def zero_stage() -> int:
     return s
 
 
+def elastic_enabled() -> bool:
+    """Live-elasticity master switch (``MXTPU_ELASTIC``, default off):
+    arms the membership-monitor pause points in ``Trainer.step`` /
+    ``Superstep.step`` (``resilience/elastic.py``) so preemption
+    notices and resize signals are processed at safe step boundaries.
+    Attaching a ``MembershipMonitor`` programmatically arms them too;
+    when off, each pause point costs one module-bool read. See
+    docs/robustness.md "Runtime elasticity"."""
+    return bool(getenv("MXTPU_ELASTIC", False, dtype=bool))
+
+
 _RETRACE_BUDGET_DEFAULT = 8
 
 

@@ -81,6 +81,9 @@ class DevicePrefetcher:
         self._stop = threading.Event()
         self._exhausted = False
         self._delivered = 0  # batches handed to the consumer this epoch
+        self._placement_gen = 0  # bumped by repartition(): staged-ahead
+        # batches carry the generation they were placed under, and a
+        # stale one is re-staged onto the CURRENT mesh at delivery
 
     # -- conversion -------------------------------------------------------
     def _jax_device(self):
@@ -159,7 +162,12 @@ class DevicePrefetcher:
             for batch in self._source:
                 if stop.is_set():
                     return
-                if not put(("ok", self._stage(batch))):
+                # generation read BEFORE staging: if a repartition()
+                # lands mid-stage the payload may be mixed across
+                # meshes, but it carries the OLD generation and is
+                # re-staged wholly at delivery
+                gen = self._placement_gen
+                if not put(("ok", (gen, self._stage(batch)))):
                     return
             put(("end", None))
         except BaseException as e:  # propagate to the consumer's next()
@@ -202,8 +210,14 @@ class DevicePrefetcher:
             _obs.DATA_PREFETCH_WAIT_SECONDS.inc(time.perf_counter() - t0)
             _obs.DATA_PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
         if kind == "ok":
+            gen, batch = payload
+            if gen != self._placement_gen:
+                # staged ahead of a repartition(): re-stage leaf-wise
+                # onto the CURRENT mesh/device — the batch is consumed
+                # exactly once, just on the new extent
+                batch = self._convert_leaf(batch, [0])
             self._delivered += 1
-            return payload
+            return batch
         self._exhausted = True
         self.close()
         if kind == "err":
@@ -212,6 +226,24 @@ class DevicePrefetcher:
 
     def next(self):
         return self.__next__()
+
+    def repartition(self, mesh=None, device=None, batch_axis=None):
+        """Re-partition the pipeline across a NEW device extent WITHOUT
+        losing position (the elastic-resize hook): the deterministic
+        ``cursor`` is untouched, batches already staged ahead on the
+        old mesh are re-staged onto the new one at delivery, and
+        everything staged from here on lands on the new extent
+        directly — a dp change never skips or replays data."""
+        if mesh is not None and device is not None:
+            raise ValueError("pass device OR mesh, not both")
+        if batch_axis is not None:
+            self._batch_axis = batch_axis
+        if mesh is not None:
+            self._mesh, self._device = mesh, None
+        elif device is not None:
+            self._device, self._mesh = device, None
+        self._placement_gen += 1
+        return self
 
     @property
     def cursor(self):
@@ -408,6 +440,14 @@ class SuperstepRing:
         groups count their K slots) — recorded by the checkpoint
         manager as the data-pipeline position."""
         return self._pf.cursor
+
+    def repartition(self, mesh=None, device=None, batch_axis=None):
+        """Delegate to the underlying prefetcher (elastic resize: the
+        cursor is preserved; staged batches re-stage onto the new
+        extent at delivery)."""
+        self._pf.repartition(mesh=mesh, device=device,
+                             batch_axis=batch_axis)
+        return self
 
     def reset(self):
         self._err = None

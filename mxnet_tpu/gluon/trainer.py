@@ -37,6 +37,8 @@ from .. import observability as _obs
 from .. import optimizer as opt
 from .. import random as _random
 from ..resilience import chaos as _chaos
+from ..resilience import checkpoint as _ckptmod
+from ..resilience import elastic as _elastic
 from ..base import MXNetError
 from ..kvstore import create as _create_kvstore
 from ..kvstore.base import KVStoreBase
@@ -233,19 +235,29 @@ class Trainer:
         if _chaos.ENABLED:
             # fault point: kill/term/raise/stall at the Nth step entry
             _chaos.step_point("trainer")
-        if _obs.introspect.PROFILING:
-            # MXTPU_PROFILE window: step-bounded jax.profiler capture,
-            # each covered step wrapped in a StepTraceAnnotation
-            with _obs.introspect.profile_step():
+        if _elastic.ENABLED:
+            # elasticity pause point: membership signals (preemption
+            # notice -> proactive checkpoint) process at the boundary,
+            # never mid-step
+            _elastic.pause_point("trainer", trainer=self)
+        # step-boundary commit protocol: a SIGTERM final checkpoint
+        # landing INSIDE this window defers to its exit, so it always
+        # snapshots a consistent post-step state
+        with _ckptmod.step_critical_section():
+            if _obs.introspect.PROFILING:
+                # MXTPU_PROFILE window: step-bounded jax.profiler
+                # capture, each covered step in a StepTraceAnnotation
+                with _obs.introspect.profile_step():
+                    out = self._step_instrumented(batch_size,
+                                                  ignore_stale_grad)
+            else:
                 out = self._step_instrumented(batch_size,
                                               ignore_stale_grad)
-        else:
-            out = self._step_instrumented(batch_size, ignore_stale_grad)
-        mgr = getattr(self, "_ckpt_manager", None)
-        if mgr is not None:
-            # async checkpoint tick: at an interval boundary this costs
-            # one copy dispatch; the write happens off-thread
-            mgr.on_step(1)
+            mgr = getattr(self, "_ckpt_manager", None)
+            if mgr is not None:
+                # async checkpoint tick: at an interval boundary this
+                # costs one copy dispatch; the write happens off-thread
+                mgr.on_step(1)
         return out
 
     def _step_instrumented(self, batch_size, ignore_stale_grad):
@@ -1228,6 +1240,11 @@ class Superstep:
             if jnp.issubdtype(raw_x.dtype, jnp.floating) and \
                     _chaos.nan_due("superstep"):
                 raw_x = raw_x.at[0].set(jnp.nan)
+        if _elastic.ENABLED:
+            # elasticity pause point: the superstep boundary is the
+            # safe place to process membership signals (K iterations
+            # commit or none do)
+            _elastic.pause_point("superstep", trainer=tr)
         if self._plan is None and any(
                 p._data is None
                 for _, p in self._block.collect_params().items()):
@@ -1245,6 +1262,16 @@ class Superstep:
                 [(NDArray(raw_x[i]), NDArray(raw_y[i])) for i in range(k)],
                 batch_size)
             return NDArray(jnp.stack([l.data for l in losses]))
+        # step-boundary commit protocol: the whole fused window (count
+        # advance -> dispatch -> write-back -> manager tick) is ONE
+        # critical section — a SIGTERM final checkpoint landing inside
+        # it (a preemption mid-scan) defers to the section exit, i.e.
+        # the last COMPLETED K-boundary, never a half-applied carry
+        with _ckptmod.step_critical_section():
+            return self._step_fused(plan, raw_x, raw_y, k, batch_size)
+
+    def _step_fused(self, plan, raw_x, raw_y, k, batch_size):
+        tr = self._trainer
         o = tr._optimizer
         scaler = getattr(tr, "_amp_loss_scaler", None)
         # host bookkeeping, once per K steps: update counts advance by

@@ -84,6 +84,16 @@ def _call_with_timeout(fn, timeout, desc):
     return box.get("out")
 
 
+def reset_world():
+    """Drop the cached one-device-per-process reduce mesh + compiled
+    reduce fn so the NEXT collective rebuilds them against the current
+    world — the elastic-resize hook: a runtime membership change
+    re-initializes the kvstore data plane without re-registering the
+    store or restarting the process."""
+    _REDUCE["mesh"] = None
+    _REDUCE["fn"] = None
+
+
 def _reduce_mesh():
     """Global mesh with ONE device per process, ordered by process index."""
     if _REDUCE["mesh"] is None:
@@ -210,12 +220,21 @@ class KVStoreDistTPU(KVStoreLocal):
         (``MXTPU_BARRIER_TIMEOUT_S``) and retry-with-backoff on
         transient failure — a preempted peer turns into a diagnosable
         crash (checkpoint + flight bundle fire on the way down), never
-        an indefinite hang."""
+        an indefinite hang. Every timed sync feeds THIS rank's wait
+        into the elastic monitor's barrier-latency histogram (the
+        rising-tail straggler *signal* — identifying WHICH peer is slow
+        needs per-rank samples delivered to one monitor: heartbeat
+        probes on a single-host mesh, or a scheduler/sidecar feeding
+        ``observe_latency(rank, s)`` on a pod), and a watchdog-diagnosed
+        dead peer is reported to it before the error propagates."""
         if jax.process_count() > 1:
+            import time as _time
+
             from jax.experimental import multihost_utils
 
             from .. import runtime
             from ..resilience import chaos as _chaos
+            from ..resilience import elastic as _elastic
 
             if _obs.ENABLED:
                 _obs.KV_BARRIER_TOTAL.inc()
@@ -225,9 +244,23 @@ class KVStoreDistTPU(KVStoreLocal):
             def attempt():
                 if _chaos.ENABLED:
                     _chaos.collective_point("barrier")
-                _call_with_timeout(
-                    lambda: multihost_utils.sync_global_devices(tag),
-                    timeout, f"kvstore barrier {tag!r}")
+                t0 = _time.perf_counter()
+                try:
+                    _call_with_timeout(
+                        lambda: multihost_utils.sync_global_devices(tag),
+                        timeout, f"kvstore barrier {tag!r}")
+                except CollectiveTimeoutError:
+                    if _elastic.ENABLED:
+                        # membership change: the monitor decides who is
+                        # evicted; the error still surfaces (this rank
+                        # cannot resize the world by itself mid-sync)
+                        _elastic.notify_dead_peer(detail=tag)
+                    raise
+                dt = _time.perf_counter() - t0
+                if _obs.ENABLED:
+                    _obs.KV_BARRIER_SECONDS.observe(dt)
+                if _elastic.ENABLED:
+                    _elastic.observe_barrier(jax.process_index(), dt)
 
             # retries cover failures raised BEFORE/WITHOUT completing
             # the sync (injected faults, transient transport errors);

@@ -296,6 +296,39 @@ CHAOS_INJECTIONS_TOTAL = _REGISTRY.counter(
     "faults injected by the chaos harness (MXTPU_CHAOS), by kind and "
     "site — nonzero outside a test run means someone left chaos armed")
 
+# -- live elasticity: runtime grow/shrink (resilience/elastic.py) ----------
+
+ELASTIC_RESIZES_TOTAL = _REGISTRY.counter(
+    "mxtpu_elastic_resizes_total",
+    "runtime mesh resizes completed WITHOUT a process restart, by "
+    "reason (chaos / notice / preempt / straggler / dead_peer / "
+    "manual / signal)")
+ELASTIC_RESIZE_SECONDS = _REGISTRY.histogram(
+    "mxtpu_elastic_resize_seconds",
+    "wall time of one in-process resize: snapshot-in-memory + mesh "
+    "rebuild + pad-clipped logical re-shard + re-entry (training is "
+    "paused exactly this long — the die->restore-from-disk "
+    "alternative costs a full restart + recompile storm)")
+ELASTIC_WORLD_SIZE = _REGISTRY.gauge(
+    "mxtpu_elastic_world_size",
+    "devices in the elastic trainer's current mesh (watch it shrink "
+    "on eviction/preemption and grow on spot add)")
+ELASTIC_STRAGGLER_EVICTIONS_TOTAL = _REGISTRY.counter(
+    "mxtpu_elastic_straggler_evictions_total",
+    "peers proactively resized out by the straggler policy "
+    "(MXTPU_STRAGGLER_FACTOR) before the barrier watchdog timeout "
+    "would have fired")
+ELASTIC_PEER_LATENCY_SECONDS = _REGISTRY.histogram(
+    "mxtpu_elastic_peer_latency_seconds",
+    "per-rank barrier/heartbeat latency samples feeding the straggler "
+    "policy, by rank (the membership monitor's barrier-latency "
+    "histogram)")
+KV_BARRIER_SECONDS = _REGISTRY.histogram(
+    "mxtpu_kvstore_barrier_seconds",
+    "wall time this process spent inside one kvstore barrier sync "
+    "(the watchdog-timed wait; a rising tail here is the straggler "
+    "signal the elastic monitor consumes)")
+
 # -- executable introspection (MXTPU_INTROSPECT; observability/introspect) --
 
 EXEC_FLOPS = _REGISTRY.gauge(

@@ -10,7 +10,8 @@ annotations on the same step (P9/P13 in SURVEY.md §2.5).
 from .mesh import make_mesh, current_mesh, data_parallel_mesh  # noqa: F401
 from .spmd import (SPMDTrainStep, shard_batch, replicate,  # noqa: F401
                    bucketed_psum,  # noqa: F401
-                   spmd_save_states, spmd_load_states)  # noqa: F401
+                   spmd_save_states, spmd_load_states,  # noqa: F401
+                   spmd_state_snapshot, spmd_restore_chunks)  # noqa: F401
 from . import overlap  # noqa: F401
 from .overlap import (BucketPlan, build_bucket_plan,  # noqa: F401
                       bucket_allreduce, bucket_reduce_scatter,

@@ -185,9 +185,23 @@ def shard_of(full, plan_or_dp, axis_name, gi=None):
         rows, jax.lax.axis_index(axis_name), axis=0, keepdims=False)
 
 
+def _chaos_point(site):
+    """Trace-time chaos fault point for the in-graph collectives: these
+    helpers run under tracing (inside jit/shard_map/scan bodies), so a
+    due one-shot ``collective`` fault (``MXTPU_CHAOS=collective@<site>``)
+    surfaces as a LOUD build/step failure at the issue point — never
+    wrong numerics, and zero extra dispatches when chaos is off (one
+    module-bool read behind a lazy import)."""
+    from ..resilience import chaos as _chaos
+
+    if _chaos.ENABLED:
+        _chaos.collective_point(site)
+
+
 def gather_shard(shard, axis_name):
     """All ranks' ``[pad/dp]`` shards -> the full ``[pad]`` flat array
     (``lax.all_gather`` tiled on the existing axis)."""
+    _chaos_point("bucket_allgather")
     return jax.lax.all_gather(shard, axis_name, tiled=True)
 
 
@@ -241,6 +255,7 @@ def bucket_allreduce(grads, axis_name, plan, postscale=None,
     ``wire_dtype`` casts each bucket to a reduced precision for the
     collective (summation happens in that dtype) and back afterwards —
     1/2 the wire bytes for bf16 gradients at bf16-sum accuracy."""
+    _chaos_point("bucket_psum")
     flat = _maybe_barrier([g.reshape(-1) for g in grads], barrier)
     out = [None] * len(grads)
     new_res = [None] * len(plan.buckets) if compress is not None else None
@@ -278,6 +293,7 @@ def bucket_reduce_scatter(grads, axis_name, plan, postscale=None,
     the bucket, sliceable per gradient without cross-rank straddling.
     Returns (per-gradient ``[pad/dp]`` shards in original order, new
     residuals or None)."""
+    _chaos_point("bucket_psum_scatter")
     dp = plan.dp
     flat = _maybe_barrier([g.reshape(-1) for g in grads], barrier)
     out = [None] * len(grads)

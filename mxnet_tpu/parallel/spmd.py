@@ -1305,6 +1305,70 @@ def _iter_state_tensors(step):
             yield f"residual::{bi}", r
 
 
+def _clipped_shard_chunks(raw, logical):
+    """Pad-clipped ``(index, host_array)`` chunks of one state tensor:
+    one chunk per replica-0 addressable shard, with flat ZeRO spans
+    clipped to the tensor's LOGICAL length (the pad is LAYOUT — a
+    function of this mesh's dp — not state, so an elastic restore with
+    a different dp/pad reads pure-logical coordinates). Slice bounds
+    are normalized to concrete ints."""
+    import numpy as onp
+
+    out = []
+    for shard in raw.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        idx = tuple(
+            slice(0 if sl.start is None else int(sl.start),
+                  int(dim) if sl.stop is None else int(sl.stop))
+            for sl, dim in zip(shard.index, raw.shape))
+        data = onp.asarray(shard.data)
+        if logical is not None and idx:
+            start, stop = idx[0].start, idx[0].stop
+            if start >= logical:
+                continue  # shard is entirely pad
+            if stop > logical:
+                data = data[:logical - start]
+                idx = (slice(start, logical),) + tuple(idx[1:])
+        out.append((idx, data))
+    return out
+
+
+def spmd_state_snapshot(step, copy=True):
+    """Checkpoint-in-memory: the step's complete state as pad-clipped
+    LOGICAL-span host chunks ``{key: [(index, np.ndarray), ...]}`` plus
+    the residual-extent map — exactly what :func:`spmd_save_states`
+    writes to disk, minus the disk leg. With ``copy`` (the default)
+    every leaf is first snapshotted in ONE donation-safe jit copy
+    dispatch (the PR-8 snapshot protocol) with the device->host
+    transfers kicked off asynchronously, so the live state can keep
+    being stepped (and donated) while the handoff drains. This is the
+    elastic-resize handoff format: :func:`spmd_restore_chunks` re-pads
+    and re-shards it onto ANY new mesh/stage layout. On a multi-host
+    mesh each process snapshots only its addressable shards."""
+    if step._state is None:
+        raise MXNetError("state_snapshot: call init_state()/step first")
+    items = list(_iter_state_tensors(step))
+    if copy:
+        from ..resilience.checkpoint import _copy_leaves
+
+        copies = _copy_leaves([jnp.asarray(raw) for _, raw in items])
+        for c in copies:
+            try:  # start the device->host transfer now
+                c.copy_to_host_async()
+            except Exception:
+                pass
+        items = [(k, c) for (k, _), c in zip(items, copies)]
+    logical = getattr(step, "_logical", None) or {}
+    chunks = {}
+    extents = {}
+    for key, raw in items:
+        chunks[key] = _clipped_shard_chunks(raw, logical.get(key))
+        if key.startswith("residual::"):
+            extents[key] = int(raw.shape[0])
+    return chunks, extents
+
+
 def spmd_save_states(step, prefix):
     """Write this process's shards of the step's params + opt states to
     ``{prefix}.shard{process_index}.npz``. On a multi-host mesh every
@@ -1318,25 +1382,7 @@ def spmd_save_states(step, prefix):
     store = {}
     logical = getattr(step, "_logical", None) or {}
     for key, raw in _iter_state_tensors(step):
-        lg = logical.get(key)
-        for shard in raw.addressable_shards:
-            if shard.replica_id != 0:
-                continue
-            idx = shard.index
-            data = onp.asarray(shard.data)
-            if lg is not None and idx:
-                # flat-padded ZeRO shard: the pad is LAYOUT (a function
-                # of this mesh's dp), not state — clip the span to the
-                # logical length so an elastic restore with a different
-                # dp (different pad) reads pure-logical coordinates
-                start = idx[0].start or 0
-                stop = idx[0].stop if idx[0].stop is not None \
-                    else raw.shape[0]
-                if start >= lg:
-                    continue  # shard is entirely pad
-                if stop > lg:
-                    data = data[:lg - start]
-                    idx = (slice(start, lg),) + tuple(idx[1:])
+        for idx, data in _clipped_shard_chunks(raw, logical.get(key)):
             store[_shard_key(key, raw, idx)] = data
     fname = f"{prefix}.shard{jax.process_index()}.npz"
     onp.savez(fname, **store)
@@ -1416,30 +1462,47 @@ def spmd_load_states(step, prefix):
                                     for tgt in local):
                         continue  # chunk entirely on other hosts
                 chunks.setdefault(name, []).append((idx, z[k]))
+    spmd_restore_chunks(step, chunks, extents=res_extent,
+                        allow_empty=all_pad)
+
+
+def spmd_restore_chunks(step, chunks, extents=None, allow_empty=()):
+    """Restore a logical-coordinate chunk set — an in-memory
+    :func:`spmd_state_snapshot` (the elastic-resize handoff) or the
+    span-filtered contents of a shard-file set — into the step's
+    CURRENT state layout: every tensor is reassembled, re-padded and
+    re-sharded for the mesh/stage the step has NOW, entirely
+    host/device-side. ``extents`` maps ``residual::N`` keys to their
+    saved global lengths (the dp-layout guard for the compression
+    carry); ``allow_empty`` names keys whose local shards are entirely
+    pad (multi-host flat tensors smaller than dp)."""
+    if step._state is None:
+        step.init_state()
+    extents = extents or {}
     params, opt_states = step._state
     new_params = []
     for n, p in zip(step._names, params):
         new_params.append(_reassemble(f"param::{n}", p, chunks,
                                       allow_empty=f"param::{n}"
-                                      in all_pad))
+                                      in allow_empty))
     new_opt = []
     for n, state in zip(step._names, opt_states):
         new_opt.append(tuple(
             _reassemble(f"opt::{n}::{li}", leaf, chunks,
-                        allow_empty=f"opt::{n}::{li}" in all_pad)
+                        allow_empty=f"opt::{n}::{li}" in allow_empty)
             for li, leaf in enumerate(state)))
     step._state = (new_params, new_opt)
     res = getattr(step, "_residuals", None)
     res_chunks = {k: v for k, v in chunks.items()
                   if k.startswith("residual::")}
     if res:
-        _restore_residuals(step, res_chunks, res_extent)
+        _restore_residuals(step, res_chunks, extents)
     elif res_chunks and getattr(step, "_compress_thr", None) is not None:
         # the carry tensors are created lazily by _init_residuals at
         # the first compiled step (the bucket plan needs a batch):
         # stash the saved chunks so they restore there instead of
         # being silently zeroed
-        step._pending_residual_chunks = (res_chunks, res_extent)
+        step._pending_residual_chunks = (res_chunks, extents)
     # push restored params back into the Gluon parameter handles so
     # eval/export paths see the checkpoint too. COPIES, not the state
     # arrays themselves: the compiled step donates its param buffers, and

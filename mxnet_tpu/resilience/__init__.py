@@ -12,15 +12,21 @@ Three legs (docs/robustness.md):
   ``parallel/spmd.py`` when the device count changed).
 - :mod:`.chaos` — deterministic fault injection (``MXTPU_CHAOS``):
   kill/term/raise-at-step, NaN-poisoned batch, one-shot collective
-  failure, slow-host stall — zero-cost (one module-bool read, zero
-  dispatches) when disabled, so robustness claims stay
-  regression-testable.
+  failure, slow-host stall, runtime ``resize`` requests — zero-cost
+  (one module-bool read, zero dispatches) when disabled, so
+  robustness claims stay regression-testable.
+- :mod:`.elastic` — LIVE elasticity (``MXTPU_ELASTIC``): membership
+  monitoring (preemption notice, dead peer, straggler policy on the
+  barrier-latency histogram) driving runtime grow/shrink of a running
+  SPMD job — checkpoint-in-memory, mesh rebuild, pad-clipped logical
+  re-shard, warm per-topology re-entry — without a process restart.
 """
 
 from __future__ import annotations
 
 from . import chaos  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import elastic  # noqa: F401
 from . import resume  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointManager,
@@ -28,7 +34,13 @@ from .checkpoint import (  # noqa: F401
     latest_checkpoint,
     maybe_checkpointing,
     verify,
+    verify_descriptor,
     write_checkpoint,
+)
+from .elastic import (  # noqa: F401
+    ElasticTrainer,
+    MembershipMonitor,
+    snapshot_descriptor,
 )
 from .resume import (  # noqa: F401
     ResumeReport,

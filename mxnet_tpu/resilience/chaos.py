@@ -19,7 +19,13 @@ Spec grammar (comma-separated faults)::
     nan:3             NaN-poison the batch staged/consumed at step 3
     stall:4:0.25      sleep 0.25 s at step 4 (slow-host straggler)
     collective:1      fail the next collective/barrier ONCE (one-shot)
+    resize:8:2        membership change: ask the elastic control loop
+                      to resize to 2 devices at its step 8 (the arg is
+                      the target device count; see resilience/elastic)
     nan@superstep:2   site-scoped: only the superstep path fires it
+    stall@rank1:p1:0.05  per-rank site: every heartbeat probe of rank 1
+                      stalls 50 ms (how a chaos-stalled straggler peer
+                      is simulated on a single-host mesh)
     nan:p0.1,seed=7   probabilistic: each eligible step fires w.p. 0.1
                       from a seeded stream (deterministic given seed)
 
@@ -41,6 +47,17 @@ Sites currently wired (docs/robustness.md has the catalog):
 - ``collective`` — ``kvstore/dist.py`` allreduce + barrier
   (``collective`` one-shot failure; the barrier's retry-with-backoff
   is what turns it into a recovered step instead of a hang)
+- ``bucket_psum`` / ``bucket_psum_scatter`` / ``bucket_allgather`` —
+  the PR-10 in-graph overlapped/ZeRO collectives
+  (``parallel/overlap.py``): a due one-shot ``collective`` fault fires
+  at the TRACE-time issue point, so a poisoned bucket collective
+  surfaces as a loud build/step failure — never wrong numerics, and
+  zero extra dispatches when chaos is off
+- ``elastic`` — the live-elasticity control loop
+  (``resilience/elastic.py``): ``resize:<step>:<n>`` requests a
+  runtime grow/shrink to ``n`` devices at that step boundary;
+  ``rank<k>`` sites stall individual heartbeat probes (straggler
+  simulation)
 """
 
 from __future__ import annotations
@@ -71,7 +88,8 @@ _STATE = {
     "fired": [],        # (fault, site, step) log for tests/telemetry
 }
 
-_FAULT_KINDS = ("kill", "term", "raise", "nan", "stall", "collective")
+_FAULT_KINDS = ("kill", "term", "raise", "nan", "stall", "collective",
+                "resize")
 
 
 class ChaosInjectedError(MXNetError):
@@ -82,7 +100,7 @@ class ChaosInjectedError(MXNetError):
 def _parse_one(tok):
     """``kind[@site]:step-or-pP[:arg]`` -> fault dict."""
     m = re.match(
-        r"^(?P<kind>[a-z]+)(@(?P<site>[a-zA-Z_]+))?"
+        r"^(?P<kind>[a-z]+)(@(?P<site>[a-zA-Z_][a-zA-Z0-9_]*))?"
         r"(:(?P<when>p?[0-9.]+))?(:(?P<arg>[0-9.]+))?$", tok.strip())
     if not m or m.group("kind") not in _FAULT_KINDS:
         raise MXNetError(
@@ -101,6 +119,10 @@ def _parse_one(tok):
         fault["prob"] = float(when[1:])
     else:
         fault["step"] = int(float(when))
+    if kind == "resize" and fault["arg"] is None:
+        raise MXNetError(
+            f"MXTPU_CHAOS: fault {tok!r} needs a target device count "
+            "(resize:<step>:<n_devices>)")
     return fault
 
 
@@ -268,6 +290,21 @@ def poison_struct(batch):
         return obj
 
     return walk(batch)
+
+
+def resize_due(site="elastic", step=None):
+    """Target device count of a due ``resize`` fault at this (site,
+    step), or None. The elastic control loop polls this once per step
+    boundary when chaos is armed — how a chaos spec drives a runtime
+    grow/shrink (``resize:8:2,resize:16:4`` = shrink to 2 at step 8,
+    grow back to 4 at step 16)."""
+    step = _advance("resize", site, step)
+    for fault in _STATE["faults"]:
+        if fault["kind"] != "resize" or not _due(fault, site, step):
+            continue
+        _record(fault, site, step)
+        return int(float(fault["arg"]))
+    return None
 
 
 def collective_point(site="collective"):

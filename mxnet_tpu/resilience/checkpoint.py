@@ -278,6 +278,58 @@ def _next_seq():
         return _TMP_SEQ[0]
 
 
+# ---------------------------------------------------------------------------
+# step-boundary critical sections: SIGTERM arriving MID-STEP (e.g. while
+# a K-iteration superstep scan executes, or between the dispatch return
+# and the param write-back loop) must not snapshot a half-applied carry.
+# Trainer.step / Superstep.step bracket their state-mutating window with
+# step_critical_section(); the SIGTERM handler defers the final save to
+# the section's exit — the last COMPLETED K-boundary — where params,
+# fused states, update counts and the manager's step counter are
+# mutually consistent. Signal handlers and the bracketing code both run
+# on the main thread, so a plain counter suffices.
+# ---------------------------------------------------------------------------
+
+_CRITICAL = [0]
+_DEFERRED = []
+
+
+def in_step_critical():
+    return _CRITICAL[0] > 0
+
+
+class _StepCritical:
+    def __enter__(self):
+        _CRITICAL[0] += 1
+        return self
+
+    def __exit__(self, *exc):
+        _CRITICAL[0] -= 1
+        if _CRITICAL[0] == 0 and _DEFERRED:
+            # deferred handlers run on EXCEPTION exits too: dropping
+            # the signal would leave the process alive after a SIGTERM
+            # it never saw. Consistency holds because the step's error
+            # paths roll their bookkeeping back before re-raising (the
+            # superstep rewinds its K-step count advance), so the
+            # deferred final save still snapshots the last completed
+            # boundary.
+            pending = list(_DEFERRED)
+            del _DEFERRED[:]
+            for fn, args in pending:
+                fn(*args)
+        return False
+
+
+def step_critical_section():
+    """Mark the code between a train step's first state mutation and its
+    last bookkeeping write as uninterruptible for the SIGTERM final
+    checkpoint: a handler firing inside (a preemption landing mid-scan)
+    is deferred to the section's exit, so the final save always commits
+    at a completed step/K-boundary — never a half-applied carry.
+    Reentrant (a superstep's single-step fallback nests Trainer.step)."""
+    return _StepCritical()
+
+
 _COMMIT_BARRIER_SEQ = [0]
 
 
@@ -575,6 +627,56 @@ def verify(path):
     return [f"{path}: {p}" for p in problems]
 
 
+DESCRIPTOR_FORMAT = "mxtpu-snapshot-v1"
+
+
+def verify_descriptor(desc):
+    """Integrity/completeness lint of an IN-MEMORY snapshot descriptor
+    (``resilience.elastic.snapshot_descriptor`` — the record a runtime
+    resize hands over). Same contract as :func:`verify`: a list of
+    problem strings, empty = verified. The payload lives in memory, so
+    the checks are manifest self-consistency (shape x dtype vs nbytes,
+    CRC presence) and completeness (every declared param and optimizer
+    leaf has at least one chunk) — not byte re-checksums."""
+    if not isinstance(desc, dict):
+        return [f"descriptor is {type(desc).__name__}, not a dict"]
+    if desc.get("format") != DESCRIPTOR_FORMAT:
+        return [f"unknown snapshot format {desc.get('format')!r}"]
+    problems = []
+    tensors = desc.get("tensors", {})
+    if not tensors:
+        problems.append("descriptor lists no tensors")
+    keys = set()
+    for k, meta in tensors.items():
+        name = k.rpartition("|")[0] or k
+        keys.add(name)
+        size = 1
+        for d in meta.get("shape", []):
+            size *= int(d)
+        try:
+            itemsize = _np_dtype(meta.get("dtype")).itemsize
+        except (TypeError, ValueError, ImportError):
+            problems.append(
+                f"tensor {k!r} has unknown dtype {meta.get('dtype')!r}")
+            continue
+        if size * itemsize != meta.get("nbytes"):
+            problems.append(
+                f"tensor {k!r} shape/dtype disagree with nbytes")
+        if not isinstance(meta.get("crc32"), int):
+            problems.append(f"tensor {k!r} missing crc32")
+    extras = desc.get("extras", {})
+    for name in extras.get("param_names", []):
+        if f"param::{name}" not in keys:
+            problems.append(f"param::{name} declared but has no chunk")
+    for name, n in extras.get("opt_leaves", {}).items():
+        for i in range(int(n)):
+            if f"opt::{name}::{i}" not in keys:
+                problems.append(
+                    f"opt state leaf opt::{name}::{i} declared but "
+                    "has no chunk")
+    return problems
+
+
 # ---------------------------------------------------------------------------
 # the manager
 # ---------------------------------------------------------------------------
@@ -853,6 +955,14 @@ class CheckpointManager:
             _logger.warning("checkpoint: cannot hook SIGTERM: %s", e)
 
     def _sigterm_handler(self, signum, frame):
+        if _CRITICAL[0] > 0:
+            # mid-step (e.g. the signal landed while a superstep scan
+            # executed and the handler ran between the dispatch return
+            # and the write-back loop): committing NOW would snapshot a
+            # half-applied carry — defer the whole handler (final save
+            # + re-raise) to the step boundary
+            _DEFERRED.append((self._sigterm_handler, (signum, None)))
+            return
         self._final_save()
         prev = self._sig_state["prev"]
         if callable(prev):

@@ -25,6 +25,26 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest
 
 
+def natsort_key(name):
+    """Natural sort key: ``dense2`` < ``dense10``. A PLAIN string sort
+    swaps layers the moment the process-global gluon auto-name counter
+    crosses a digit boundary mid-session (dense99 -> dense100 sorts
+    before dense99's peers), silently pairing the wrong layers in any
+    test that zips two sorted ``collect_params()`` views — a latent
+    order-dependent flake (PR 10 hit it in test_overlap_zero)."""
+    import re
+
+    return [int(t) if t.isdigit() else t
+            for t in re.split(r"(\d+)", name)]
+
+
+def natsorted_items(items):
+    """``(name, value)`` pairs sorted by NATURAL name order — the one
+    way tests should order ``collect_params().items()`` / fused-state
+    dicts (see :func:`natsort_key`)."""
+    return sorted(items, key=lambda kv: natsort_key(kv[0]))
+
+
 def pytest_configure(config):
     # XLA:CPU has no buffer donation; the fused step donates anyway
     # (no-op) and jax warns once per compiled function — pure noise here

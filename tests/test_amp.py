@@ -9,6 +9,8 @@ import os
 import numpy as np
 import pytest
 
+from conftest import natsorted_items
+
 import mxnet_tpu as mx
 from mxnet_tpu import amp, autograd, fusedstep, gluon, observability as obs
 from mxnet_tpu.gluon import nn
@@ -219,7 +221,7 @@ def test_fused_bf16_master_weights_in_state():
         l.backward()
         tr.step(4)
     assert tr._fused not in (False, None)
-    name, st = next(iter(sorted(tr._fused_states.items())))
+    name, st = natsorted_items(tr._fused_states.items())[0]
     # (fp32 master, fp32 momentum) for a bf16 param
     assert len(st) == 2 and all(str(s.dtype) == "float32" for s in st)
     p = dict(net.collect_params().items())[name]
@@ -272,7 +274,7 @@ def test_mp_bf16_fused_to_eager_migration_keeps_master():
                 l.backward()
                 tr.step(8)
             fusedstep.set_enabled(True)
-            p = sorted(net.collect_params().items())[0][1]
+            p = natsorted_items(net.collect_params().items())[0][1]
             return p.data().asnumpy().astype(np.float32)
         finally:
             fusedstep.set_enabled(True)

@@ -33,6 +33,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR6_OUT"] = str(tmp_path / "BENCH_pr6.json")
     env["BENCH_PR8_OUT"] = str(tmp_path / "BENCH_pr8.json")
     env["BENCH_PR10_OUT"] = str(tmp_path / "BENCH_pr10.json")
+    env["BENCH_PR11_OUT"] = str(tmp_path / "BENCH_pr11.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -44,45 +45,10 @@ def _warm_cache_rec(recs):
     return warm[0] if warm else None
 
 
-def _rerun_cache_probe(env):
-    """A warm-cache miss count > 0 is almost always host pressure (slow
-    cache writes / probe timeouts), not a regression — re-run JUST the
-    input_pipeline scenario in a clean subprocess once before failing."""
-    env2 = dict(env)
-    env2["BENCH_ONLY"] = "input_pipeline"
-    # the retry must not clobber the full run's records under assert
-    env2["BENCH_PR4_OUT"] = env["BENCH_PR4_OUT"] + ".retry"
-    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
-    res = subprocess.run(
-        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
-        env=env2, capture_output=True, text=True, timeout=600)
-    recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
-            if ln.startswith("{")]
-    return _warm_cache_rec(recs), res
-
-
 def _ckpt_rec(recs):
     ck = [r for r in recs
           if r["metric"].startswith("checkpoint_async_superstep")]
     return ck[0] if ck else None
-
-
-def _rerun_checkpoint_probe(env):
-    """Checkpoint overhead > 5% during the full run is almost always
-    suite-wide host pressure (every test shares this core with the
-    background writer), not a regression — re-run JUST the checkpoint
-    scenario in a clean subprocess once before failing (the same
-    policy as the warm-cache probe above)."""
-    env2 = dict(env)
-    env2["BENCH_ONLY"] = "checkpoint"
-    env2["BENCH_PR8_OUT"] = env["BENCH_PR8_OUT"] + ".retry"
-    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
-    res = subprocess.run(
-        [sys.executable, "-c", _RUNNER.format(root=ROOT)],
-        env=env2, capture_output=True, text=True, timeout=600)
-    recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
-            if ln.startswith("{")]
-    return _ckpt_rec(recs), res
 
 
 def _overlap_rec(recs):
@@ -90,22 +56,40 @@ def _overlap_rec(recs):
     return ov[0] if ov else None
 
 
-def _rerun_overlap_probe(env):
-    """A zero/negative comm-hidden fraction during the full run is
-    almost always host pressure (the probe times four compiled legs on
-    a shared core), not a scheduling regression — re-run JUST the
-    overlap scenario in a clean subprocess once before failing (same
-    policy as the warm-cache and checkpoint probes)."""
+def _elastic_rec(recs):
+    el = [r for r in recs if r["metric"].startswith("elastic_resize")]
+    return el[0] if el else None
+
+
+#: the shared BENCH_ONLY re-run contract: a timing/pressure-sensitive
+#: assert that fails during the FULL run gets exactly one clean-
+#: subprocess retry of JUST its scenario (host pressure across a 10-
+#: scenario suite must not masquerade as a regression), with the
+#: retried scenario's record outputs redirected to ``.retry`` files so
+#: the full run's committed records stay what the other asserts see.
+#: scenario name -> (record picker, env keys of its record outputs)
+_STANDALONE = {
+    "input_pipeline": (_warm_cache_rec, ("BENCH_PR4_OUT",)),
+    "checkpoint": (_ckpt_rec, ("BENCH_PR8_OUT",)),
+    "overlap": (_overlap_rec, ("BENCH_PR10_OUT",)),
+    "elastic": (_elastic_rec, ("BENCH_PR11_OUT",)),
+}
+
+
+def _rerun_standalone(env, scenario):
+    """Re-run ONE scenario standalone (see ``_STANDALONE``); returns
+    (its record or None, the completed subprocess)."""
+    picker, out_keys = _STANDALONE[scenario]
     env2 = dict(env)
-    env2["BENCH_ONLY"] = "overlap"
-    env2["BENCH_PR10_OUT"] = env["BENCH_PR10_OUT"] + ".retry"
-    env2["BENCH_STATUS_OUT"] = env["BENCH_STATUS_OUT"] + ".retry"
+    env2["BENCH_ONLY"] = scenario
+    for key in out_keys + ("BENCH_STATUS_OUT",):
+        env2[key] = env[key] + ".retry"
     res = subprocess.run(
         [sys.executable, "-c", _RUNNER.format(root=ROOT)],
         env=env2, capture_output=True, text=True, timeout=600)
     recs = [json.loads(ln) for ln in res.stdout.strip().splitlines()
             if ln.startswith("{")]
-    return _overlap_rec(recs), res
+    return picker(recs), res
 
 
 def test_bench_emits_driver_contract(tmp_path):
@@ -133,7 +117,7 @@ def test_bench_emits_driver_contract(tmp_path):
     # pressure must not masquerade as a cache regression)
     warm = _warm_cache_rec(recs)
     if not (warm and warm["cache_misses"] == 0):
-        warm, res2 = _rerun_cache_probe(env)
+        warm, res2 = _rerun_standalone(env, "input_pipeline")
         assert warm and warm["cache_misses"] == 0, \
             (warm, res.stderr[-1000:], res2.stderr[-1000:])
     # superstep scenario (PR6): K=1 vs K=8 legs, dispatches/step
@@ -155,7 +139,7 @@ def test_bench_emits_driver_contract(tmp_path):
     pr8 = json.load(open(tmp_path / "BENCH_pr8.json"))
     assert pr8["scenario"] == "checkpoint" and pr8["verified"], pr8
     if not ck["overhead_pct"] < 5.0:
-        ck, res2 = _rerun_checkpoint_probe(env)
+        ck, res2 = _rerun_standalone(env, "checkpoint")
         assert ck and ck["overhead_pct"] < 5.0, \
             (ck, res.stderr[-1000:], res2.stderr[-1000:])
     # overlapped-allreduce scenario (PR10): the bucket-ready schedule
@@ -165,9 +149,33 @@ def test_bench_emits_driver_contract(tmp_path):
     ov = _overlap_rec(recs)
     assert ov, names
     if not (ov.get("comm_hidden_fraction") or 0) > 0:
-        ov, res2 = _rerun_overlap_probe(env)
+        ov, res2 = _rerun_standalone(env, "overlap")
         assert ov and (ov.get("comm_hidden_fraction") or 0) > 0, \
             (ov, res.stderr[-1000:], res2.stderr[-1000:])
+    # live-elasticity scenario (PR11): a chaos-driven mid-run 4->2->4
+    # resize loses ZERO committed steps (bit-exact state at the resize
+    # boundary), completes without a process restart, evicts the
+    # chaos-stalled straggler, and recovers >=90% of steady-state
+    # throughput after warm re-entry (throughput is the one pressure-
+    # sensitive number — it gets the standalone retry)
+    el = _elastic_rec(recs)
+    assert el, names
+    assert el["committed_steps_lost"] == 0, el
+    assert el["boundary_bitexact"] is True, el
+    assert el["losses_bitexact_to_boundary"] is True, el
+    assert el["descriptor_verified"] is True, el
+    assert el["straggler_evicted"] is True, el
+    assert el["resizes"] == 2, el
+    if not el["value"] >= 0.9:
+        el, res2 = _rerun_standalone(env, "elastic")
+        assert el and el["value"] >= 0.9 \
+            and el["committed_steps_lost"] == 0 \
+            and el["boundary_bitexact"] is True, \
+            (el, res.stderr[-1000:], res2.stderr[-1000:])
+    pr11 = json.load(open(tmp_path / "BENCH_pr11.json"))
+    assert pr11["scenario"] == "elastic" \
+        and pr11["committed_steps_lost"] == 0 \
+        and pr11["boundary_bitexact"] and pr11["warm_reentry"], pr11
     for stage in ("2", "3"):
         zr = [r for r in recs
               if r["metric"].startswith(f"zero{stage}_optgrad_mem")]
@@ -190,7 +198,8 @@ def test_bench_emits_driver_contract(tmp_path):
     status = json.load(open(tmp_path / "BENCH_STATUS.json"))
     assert status["rc"] == 0, status
     assert "amp" in status["completed"] and "superstep" in \
-        status["completed"] and not status["failed"], status
+        status["completed"] and "elastic" in status["completed"] \
+        and not status["failed"], status
     # MFU accounting contract (PR7): EVERY row carries flops_per_step
     # and mfu; a null always pairs with a reason (this CPU smoke has no
     # peak table, so mfu is null-with-reason while flops_per_step is

@@ -1,0 +1,534 @@
+"""Live elasticity (ISSUE 11 tentpole): runtime grow/shrink without a
+process restart — chaos-driven 4->2->4 resize with bit-exact state at
+the boundary and zero committed steps lost, straggler detection via the
+barrier-latency policy (chaos-stalled rank evicted BEFORE the watchdog
+timeout would fire, pinned by a subprocess test), preemption-notice
+pause points, in-memory snapshot descriptors + the --from-json
+verifier, prefetcher cursor re-partition, and the extended chaos fault
+sites (bucket collectives, resize) with the zero-dispatch-when-off
+contract re-pinned."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+from conftest import natsorted_items
+
+import jax
+import numpy as onp
+from jax.sharding import Mesh
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, observability as obs, parallel, resilience
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.resilience import chaos, elastic
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEVS = jax.devices()
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_and_monitor():
+    yield
+    chaos.reset()
+    if elastic.monitor() is not None:
+        elastic.monitor().detach()
+
+
+def _build(width=16, classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(width, activation="relu", in_units=8))
+    net.add(nn.Dense(classes, in_units=width))
+    net.initialize(init=mx.initializer.Constant(0.0))
+    r = np.random.RandomState(7)
+    for _, p in natsorted_items(net.collect_params().items()):
+        p.set_data(mx.nd.array(
+            r.uniform(-0.2, 0.2, p.shape).astype(np.float32)))
+    net.hybridize()
+    return net
+
+
+def _batch(n=12):
+    r = np.random.RandomState(0)
+    return (r.rand(n, 8).astype(np.float32),
+            r.randint(0, 4, (n,)).astype(np.float32))
+
+
+def _canon(chunks):
+    """Auto-name-independent view: natural-sorted positional order of
+    keys, chunk spans + payload bytes."""
+    out = []
+    for key in sorted(chunks, key=lambda k: [
+            int(t) if t.isdigit() else t
+            for t in __import__("re").split(r"(\d+)", k)]):
+        out.append(sorted(
+            (tuple((sl.start, sl.stop) for sl in idx), d.tobytes())
+            for idx, d in chunks[key]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the tentpole: chaos-driven 4->2->4 with bit-exact boundary state
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("opt,stage", [("adam", 2), ("sgd", 3),
+                                       ("adam", 0)],
+                         ids=["adam_zero2", "sgd_zero3", "adam_zero0"])
+def test_chaos_resize_4_2_4_bitexact_zero_lost(opt, stage):
+    """A mid-run 4->2->4 resize: zero committed steps lost, the
+    in-memory snapshot at the shrink boundary is BIT-EXACT with an
+    uninterrupted dp=4 reference (ZeRO-2/3 state crossing two pad
+    layouts through the logical-span machinery), the first-phase losses
+    match bit-exactly, and re-growing to dp=4 re-enters WARM (the
+    cached executable, no recompile)."""
+    x, y = _batch()
+    hyper = {"momentum": 0.9} if opt == "sgd" else {}
+
+    mesh4 = Mesh(onp.array(DEVS[:4]), ("dp",))
+    net_ref = _build()
+    mx.random.seed(42)
+    ref = parallel.SPMDTrainStep(net_ref, loss_fn, opt, dict(hyper),
+                                 mesh=mesh4, zero_stage=stage)
+    ref_losses = [ref(x, y, lr=0.05) for _ in range(5)]
+    ref_chunks = _canon(parallel.spmd_state_snapshot(ref)[0])
+
+    chaos.configure("resize:6:2,resize:9:4")
+    snap = {}
+    net_el = _build()
+    mx.random.seed(42)
+    et = elastic.ElasticTrainer(
+        net_el, loss_fn, opt, dict(hyper), devices=list(DEVS[:4]),
+        device_pool=list(DEVS[:4]), zero_stage=stage,
+        on_resize=lambda ev, ch: snap.setdefault("chunks", ch))
+    losses = [et.step(x, y, lr=0.05) for _ in range(11)]
+    chaos.reset()
+
+    assert [e["to"] for e in et.resize_events] == [2, 4]
+    assert et.resize_events[0]["step"] == 5  # boundary: 5 committed
+    assert et.committed_steps == 11 and len(losses) == 11  # zero lost
+    assert losses[:5] == ref_losses
+    assert _canon(snap["chunks"]) == ref_chunks  # bit-exact handoff
+    assert et.resize_events[1]["warm"] is True  # 2->4 reuses the step
+    assert resilience.verify_descriptor(et.last_descriptor) == []
+    et.close()
+
+
+def test_multi_eviction_one_drain_removes_the_right_devices():
+    """Two ranks flagged in the SAME drain evict the right devices:
+    rank indices refer to the enqueue-time device list, so they are
+    applied as a set against it (a sequential pop would shift indices
+    and evict a healthy peer). Grow-after-evict in one drain must not
+    re-add a just-evicted device."""
+    x, y = _batch()
+    et = elastic.ElasticTrainer(_build(), loss_fn, "sgd", {},
+                                devices=list(DEVS[:4]),
+                                device_pool=list(DEVS[:6]),
+                                min_devices=1)
+    et.step(x, y, lr=0.05)
+    et.monitor._enqueue({"kind": "dead_peer", "reason": "dead_peer",
+                         "target": None, "rank": 1, "detail": ""})
+    et.monitor._enqueue({"kind": "straggler", "reason": "straggler",
+                         "target": None, "rank": 2, "detail": ""})
+    et.step(x, y, lr=0.05)
+    assert et.devices == [DEVS[0], DEVS[3]], et.devices  # 1 AND 2 out
+    # evicted devices never return via a same-drain grow
+    et.monitor._enqueue({"kind": "straggler", "reason": "straggler",
+                         "target": None, "rank": 1, "detail": ""})
+    et.monitor.request_resize(3, reason="grow")
+    et.step(x, y, lr=0.05)
+    assert DEVS[3] not in et.devices and len(et.devices) == 3, \
+        et.devices
+    et.close()
+
+
+def test_resize_drops_old_topology_state():
+    """Warm re-entry keeps only the COMPILED executable per topology:
+    the old step's full param/opt-state copy is dropped at resize (one
+    model's worth of device memory per topology otherwise), and a
+    later re-entry re-initializes + restores over it."""
+    x, y = _batch()
+    chaos.configure("resize:3:2,resize:5:4")
+    et = elastic.ElasticTrainer(_build(), loss_fn, "adam", {},
+                                devices=list(DEVS[:4]), zero_stage=2)
+    l1 = [et.step(x, y, lr=0.05) for _ in range(2)]
+    old = et.spmd_step
+    et.step(x, y, lr=0.05)  # shrink fires here
+    assert et.spmd_step is not old and old._state is None
+    et.step(x, y, lr=0.05)
+    et.step(x, y, lr=0.05)  # grow back: re-enters the dropped step
+    chaos.reset()
+    assert et.spmd_step is old and old._state is not None
+    assert et.resize_events[1]["warm"] is True
+    et.step(x, y, lr=0.05)  # and it still trains
+    et.close()
+
+
+def test_grow_and_clip_contracts():
+    """Grow extends from the pool (spot add), a target beyond the pool
+    clips to it, and a shrink below min_devices clips up to it."""
+    x, y = _batch()
+    et = elastic.ElasticTrainer(_build(), loss_fn, "sgd", {},
+                                devices=list(DEVS[:2]),
+                                device_pool=list(DEVS[:4]),
+                                min_devices=2)
+    et.step(x, y, lr=0.05)
+    et.monitor.request_resize(8, reason="grow")  # pool only has 4
+    et.step(x, y, lr=0.05)
+    assert len(et.devices) == 4
+    et.monitor.request_resize(1, reason="shrink")  # min_devices=2
+    et.step(x, y, lr=0.05)
+    assert len(et.devices) == 2
+    et.close()
+
+
+# ---------------------------------------------------------------------------
+# straggler: barrier-latency policy evicts a chaos-stalled rank
+# ---------------------------------------------------------------------------
+
+def test_straggler_policy_math():
+    mon = elastic.MembershipMonitor(straggler_factor=3.0,
+                                    min_samples=3, min_latency_s=0.01)
+    for i in range(3):
+        for r in range(4):
+            mon.observe_latency(r, 0.05 if r == 2 else 0.001)
+    assert mon.straggler_ranks() == [2]
+    sigs = mon.drain()
+    assert [s["kind"] for s in sigs] == ["straggler"]  # flagged ONCE
+    assert sigs[0]["rank"] == 2
+    # below the absolute floor nothing is flagged, however skewed
+    mon2 = elastic.MembershipMonitor(straggler_factor=3.0,
+                                     min_samples=3, min_latency_s=0.01)
+    for i in range(3):
+        for r in range(4):
+            mon2.observe_latency(r, 0.005 if r == 1 else 0.0001)
+    assert mon2.straggler_ranks() == []
+    # too few samples: no verdict
+    mon3 = elastic.MembershipMonitor(straggler_factor=3.0, min_samples=5)
+    for r in range(4):
+        mon3.observe_latency(r, 0.5 if r == 0 else 0.001)
+    assert mon3.straggler_ranks() == []
+
+
+def test_straggler_evicted_in_process():
+    x, y = _batch()
+    chaos.configure("stall@rank2:p1:0.05")
+    mon = elastic.MembershipMonitor(straggler_factor=3.0,
+                                    min_latency_s=0.02)
+    et = elastic.ElasticTrainer(_build(), loss_fn, "sgd",
+                                {"momentum": 0.9},
+                                devices=list(DEVS[:4]), monitor=mon,
+                                zero_stage=2)
+    for _ in range(8):
+        et.step(x, y, lr=0.05)
+        if et.resize_events:
+            break
+    chaos.reset()
+    assert et.resize_events and \
+        et.resize_events[0]["reason"] == "straggler"
+    assert len(et.devices) == 3 and DEVS[2] not in et.devices
+    # training continues on the shrunk mesh (24 % 3 == 0)
+    et.step(x, y, lr=0.05)
+    et.close()
+
+
+def test_straggler_evicted_before_watchdog_subprocess(tmp_path):
+    """The acceptance pin: in a fresh process with the barrier watchdog
+    armed (MXTPU_BARRIER_TIMEOUT_S), a chaos-stalled peer is detected
+    via the latency histogram and resized out with the job still
+    running — in far less wall time than the watchdog timeout that
+    would otherwise have been the first sign of trouble."""
+    timeout_s = 60.0
+    child = f"""
+import json, time, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {ROOT!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.resilience import chaos, elastic
+from mxnet_tpu.gluon import nn
+devs = jax.devices()
+assert len(devs) >= 4, devs
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu", in_units=8))
+net.add(nn.Dense(4, in_units=16))
+net.initialize(init=mx.initializer.Xavier()); net.hybridize()
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+r = np.random.RandomState(0)
+x = r.rand(12, 8).astype(np.float32)
+y = r.randint(0, 4, (12,)).astype(np.float32)
+mon = elastic.MembershipMonitor(min_latency_s=0.02)
+et = elastic.ElasticTrainer(net, loss_fn, "sgd", {{"momentum": 0.9}},
+                            devices=list(devs[:4]), monitor=mon,
+                            zero_stage=2)
+t0 = time.monotonic()
+for i in range(12):
+    et.step(x, y, lr=0.05)
+    if et.resize_events:
+        break
+wall = time.monotonic() - t0
+et.step(x, y, lr=0.05)   # the job is ALIVE after the eviction
+print("RESULT " + json.dumps({{
+    "events": et.resize_events, "wall": wall,
+    "devices": len(et.devices)}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f) + \
+        " --xla_force_host_platform_device_count=4"
+    env["MXTPU_CHAOS"] = "stall@rank1:p1:0.05"
+    env["MXTPU_STRAGGLER_FACTOR"] = "3.0"
+    env["MXTPU_BARRIER_TIMEOUT_S"] = str(timeout_s)
+    res = subprocess.run([sys.executable, "-c", child], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert res.returncode == 0, res.stderr[-2000:]
+    line = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    data = json.loads(line[len("RESULT "):])
+    assert data["events"], data
+    assert data["events"][0]["reason"] == "straggler", data
+    assert data["devices"] == 3, data
+    # resized out well before the watchdog would have fired
+    assert data["wall"] < timeout_s / 2, data
+
+
+# ---------------------------------------------------------------------------
+# preemption notice: resize + the Trainer pause point
+# ---------------------------------------------------------------------------
+
+def test_preempt_notice_shrink_then_grow(tmp_path):
+    x, y = _batch()
+    notice = tmp_path / "notice"
+    mon = elastic.MembershipMonitor(notice_path=str(notice))
+    et = elastic.ElasticTrainer(_build(), loss_fn, "adam", {},
+                                devices=list(DEVS[:4]), monitor=mon,
+                                zero_stage=2)
+    et.step(x, y, lr=0.05)
+    notice.write_text("shrink:2")
+    et.step(x, y, lr=0.05)
+    assert len(et.devices) == 2
+    assert et.resize_events[0]["reason"] == "notice"
+    time.sleep(0.01)  # distinct mtime
+    notice.write_text("grow:4")
+    et.step(x, y, lr=0.05)
+    assert len(et.devices) == 4
+    assert et.resize_events[1]["warm"] is True
+    et.close()
+
+
+def test_preempt_notice_proactive_checkpoint_at_pause_point(tmp_path):
+    """The Gluon path: a preemption notice turns into a PROACTIVE async
+    checkpoint at the next Trainer.step boundary (the pause point) —
+    no mesh to rebuild, but the recovery point is fresh before the
+    SIGTERM even lands."""
+    notice = tmp_path / "notice"
+    net = _build()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=None)
+    mgr = resilience.CheckpointManager(
+        str(tmp_path / "ck"), every_n_steps=10 ** 6, net=net,
+        trainer=tr).attach(tr)
+    mon = elastic.MembershipMonitor(notice_path=str(notice)).attach()
+    x, y = _batch(8)
+    X, Y = mx.nd.array(x), mx.nd.array(y)
+    try:
+        from mxnet_tpu import autograd
+
+        def one():
+            with autograd.record():
+                l = loss_fn(net(X), Y)
+            l.backward()
+            tr.step(8)
+
+        one(), one()
+        assert mgr.commits == 0  # interval never fires
+        notice.write_text("")    # plain preemption notice
+        one()
+        assert mgr.flush(timeout=60)
+        assert mgr.commits == 1
+        man = json.load(open(os.path.join(mgr.last_saved,
+                                          "MANIFEST.json")))
+        assert man["reason"] == "preempt_notice"
+        # one notice = one checkpoint (consumed, not re-fired)
+        one()
+        mgr.flush(timeout=60)
+        assert mgr.commits == 1
+    finally:
+        mon.detach()
+        mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# descriptors: verify_descriptor + the --from-json CLI
+# ---------------------------------------------------------------------------
+
+def test_descriptor_verify_and_cli(tmp_path):
+    x, y = _batch()
+    et = elastic.ElasticTrainer(_build(), loss_fn, "adam", {},
+                                devices=list(DEVS[:4]), zero_stage=2)
+    et.step(x, y, lr=0.05)
+    desc = et.snapshot(reason="manual")
+    assert resilience.verify_descriptor(desc) == []
+    p = et.dump_descriptor(tmp_path / "desc.json")
+    tool = os.path.join(ROOT, "tools", "verify_checkpoint.py")
+    res = subprocess.run([sys.executable, tool, "--from-json", p],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.startswith("OK"), res.stdout
+
+    # corruption is CAUGHT: nbytes mismatch, missing opt leaf, bad fmt
+    bad = json.loads(open(p).read())
+    k = next(iter(bad["tensors"]))
+    bad["tensors"][k]["nbytes"] += 4
+    name = next(iter(bad["extras"]["opt_leaves"]))
+    bad["extras"]["opt_leaves"][name] += 1
+    bad_p = tmp_path / "bad.json"
+    bad_p.write_text(json.dumps(bad))
+    probs = resilience.verify_descriptor(bad)
+    assert any("nbytes" in q for q in probs), probs
+    assert any("opt state leaf" in q for q in probs), probs
+    res = subprocess.run([sys.executable, tool, "--from-json",
+                          str(bad_p)],
+                         capture_output=True, text=True, timeout=120)
+    assert res.returncode == 1, res.stdout
+    assert resilience.verify_descriptor({"format": "nope"}) \
+        == ["unknown snapshot format 'nope'"]
+    et.close()
+
+
+# ---------------------------------------------------------------------------
+# input pipeline: cursor-preserving repartition
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_repartition_preserves_cursor_and_data():
+    from mxnet_tpu.gluon.data.prefetcher import DevicePrefetcher
+
+    mesh4 = Mesh(onp.array(DEVS[:4]), ("dp",))
+    mesh2 = Mesh(onp.array(DEVS[:2]), ("dp",))
+    batches = [np.full((12, 4), i, np.float32) for i in range(6)]
+    pf = DevicePrefetcher(batches, mesh=mesh4, depth=4)
+    it = iter(pf)
+    got = [next(it) for _ in range(2)]
+    assert pf.cursor == 2
+    pf.repartition(mesh=mesh2)  # mid-epoch, staged batches in flight
+    got += list(it)
+    assert pf.cursor == 6
+    # every batch delivered exactly once, in order, values intact
+    vals = [float(np.asarray(b.data)[0, 0]) for b in got]
+    assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+    # everything delivered after the repartition lives on the 2-mesh
+    for b in got[2:]:
+        assert set(b.data.sharding.device_set) <= set(DEVS[:2]), \
+            b.data.sharding
+    pf.close()
+
+
+def test_superstep_ring_repartition_delegates():
+    from mxnet_tpu.gluon.data.prefetcher import SuperstepRing
+
+    mesh4 = Mesh(onp.array(DEVS[:4]), ("dp",))
+    mesh2 = Mesh(onp.array(DEVS[:2]), ("dp",))
+    batches = [(np.full((8, 4), i, np.float32),
+                np.zeros((8,), np.float32)) for i in range(4)]
+    ring = SuperstepRing(batches, k=2, mesh=mesh4)
+    it = iter(ring)
+    g1, k1 = next(it)
+    assert k1 == 2 and ring.cursor == 2
+    ring.repartition(mesh=mesh2)
+    g2, k2 = next(it)
+    assert k2 == 2 and ring.cursor == 4
+    assert set(g2[0].data.sharding.device_set) <= set(DEVS[:2])
+    ring.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: new fault sites + the zero-dispatch-when-off contract
+# ---------------------------------------------------------------------------
+
+def test_chaos_bucket_collective_faults_surface_loudly():
+    x, y = _batch()
+    mesh4 = Mesh(onp.array(DEVS[:4]), ("dp",))
+    chaos.configure("collective@bucket_psum:1")
+    st = parallel.SPMDTrainStep(_build(), loss_fn, "sgd", {},
+                                mesh=mesh4, zero_stage=0)
+    with pytest.raises(chaos.ChaosInjectedError):
+        st(x, y, lr=0.05)
+    chaos.reset()
+    chaos.configure("collective@bucket_psum_scatter:1")
+    st2 = parallel.SPMDTrainStep(_build(), loss_fn, "adam", {},
+                                 mesh=mesh4, zero_stage=2)
+    with pytest.raises(chaos.ChaosInjectedError):
+        st2(x, y, lr=0.05)
+    chaos.reset()
+    chaos.configure("collective@bucket_allgather:1")
+    st3 = parallel.SPMDTrainStep(_build(), loss_fn, "sgd", {},
+                                 mesh=mesh4, zero_stage=3)
+    with pytest.raises(chaos.ChaosInjectedError):
+        st3(x, y, lr=0.05)
+    chaos.reset()
+
+
+def test_chaos_resize_spec_parsing():
+    faults = chaos.configure("resize:8:2,resize@elastic:16:4")
+    assert faults[0]["kind"] == "resize" and faults[0]["arg"] == "2"
+    assert faults[1]["site"] == "elastic"
+    chaos.reset()
+    with pytest.raises(mx.MXNetError):
+        chaos.configure("resize:8")  # target count is mandatory
+    chaos.reset()
+    # per-rank sites parse (digit-bearing site names)
+    faults = chaos.configure("stall@rank12:p0.5:0.1,seed=3")
+    assert faults[0]["site"] == "rank12"
+    chaos.reset()
+
+
+def test_chaos_off_adds_zero_dispatches_elastic_loop():
+    """The new fault sites keep the zero-cost contract: the per-step
+    dispatch count of the elastic SPMD loop (bucket collectives inside,
+    resize poll at the boundary) is IDENTICAL with chaos off and with
+    chaos armed-but-never-firing."""
+    x, y = _batch()
+    prev = obs.set_enabled(True)
+    try:
+        def measure(spec):
+            if spec:
+                chaos.configure(spec)
+            et = elastic.ElasticTrainer(
+                _build(), loss_fn, "sgd", {}, devices=list(DEVS[:4]),
+                zero_stage=2)
+            et.step(x, y, lr=0.05)  # warm: compile
+            c0 = obs.XLA_DISPATCH_TOTAL.total()
+            for _ in range(4):
+                et.step(x, y, lr=0.05)
+            out = (obs.XLA_DISPATCH_TOTAL.total() - c0) / 4
+            et.close()
+            chaos.reset()
+            return out
+
+        base = measure(None)
+        armed = measure("resize:999999:2,collective@bucket_psum:999999")
+        assert base == armed, (base, armed)
+    finally:
+        obs.set_enabled(prev)
+
+
+# ---------------------------------------------------------------------------
+# kvstore hook
+# ---------------------------------------------------------------------------
+
+def test_kvstore_reset_world_clears_reduce_cache():
+    from mxnet_tpu.kvstore import dist as kvd
+
+    kvd._REDUCE["mesh"] = "stale"
+    kvd._REDUCE["fn"] = "stale"
+    kvd.reset_world()
+    assert kvd._REDUCE["mesh"] is None and kvd._REDUCE["fn"] is None

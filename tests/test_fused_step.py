@@ -4,6 +4,7 @@ the fallback contract (never wrong answers, loudly logged)."""
 
 import numpy as np
 import pytest
+from conftest import natsorted_items
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, fusedstep, gluon, observability as obs
@@ -18,10 +19,12 @@ def _fused_on():
 
 
 def _sorted_weights(net):
-    # param names carry run-dependent global prefixes; sort by suffix
+    # param names carry run-dependent global prefixes; NATURAL sort
+    # (conftest) keeps layer order stable across the gluon auto-name
+    # counter's digit boundaries (dense99 -> dense100) and pairs two
+    # nets built back-to-back positionally
     return [p.data().asnumpy() for _, p in
-            sorted(net.collect_params().items(),
-                   key=lambda kv: kv[0].split("_", 1)[-1])]
+            natsorted_items(net.collect_params().items())]
 
 
 def _build_mlp(n_hidden, width=16, in_units=8, classes=3):
@@ -732,7 +735,7 @@ def test_freezing_param_midrun_rebuilds_plan():
                                kvstore=None)
             X = mx.nd.array(np.random.RandomState(1).randn(8, 8)
                             .astype(np.float32))
-            frozen = sorted(net.collect_params().items())[0][1]
+            frozen = natsorted_items(net.collect_params().items())[0][1]
             snap = None
             for i in range(6):
                 if i == 3:

@@ -48,17 +48,10 @@ def _net(dtype="float32"):
     return net
 
 
-def _psorted(items):
-    """Natural-sorted params: a plain name sort is lexicographic, so
-    when the process-global gluon layer counter straddles a digit
-    boundary (dense99 -> dense100) the layers swap and the rng draws
-    land on the wrong params — an ordering-dependent flake in a long
-    pytest session."""
-    import re
-
-    return sorted(items, key=lambda kv: [
-        int(s) if s.isdigit() else s
-        for s in re.split(r"(\d+)", kv[0])])
+from conftest import natsorted_items as _psorted  # noqa: E402 — the
+# natural sort lives in conftest now (shared with test_fused_step /
+# test_higher_order_grad / test_amp); a plain name sort swaps layers
+# when the gluon auto-name counter straddles a digit boundary
 
 
 def _weights(net):

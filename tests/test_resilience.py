@@ -323,7 +323,8 @@ MODE = {mode!r}            # "full" | "resume"
 SUPERSTEP = {superstep!r}  # 0 or K
 FP16 = {fp16!r}
 OPT = {opt!r}
-STEPS = 12
+KILL_MID = {kill_mid!r}    # arm a timer to SIGTERM ourselves MID-scan
+STEPS = {steps!r}
 
 np.random.seed(0)  # initializers draw from np.random (conftest seeds
 mx.random.seed(0)  # it for in-process tests; a bare child must too)
@@ -367,11 +368,27 @@ def one_step():
 
 losses = []
 if SUPERSTEP:
-    from mxnet_tpu.gluon.data.prefetcher import stack_batches
+    import signal as _signal
+    import threading as _threading
+    import time as _time
     sstep = gluon.Superstep(net, loss_fn, tr, k=SUPERSTEP)
+    from mxnet_tpu.gluon.data.prefetcher import stack_batches
     xs = stack_batches([X] * SUPERSTEP)
     ys = stack_batches([Y] * SUPERSTEP)
-    for _ in range(start // SUPERSTEP, STEPS // SUPERSTEP):
+    for g in range(start // SUPERSTEP, STEPS // SUPERSTEP):
+        if KILL_MID and g == start // SUPERSTEP + 2:
+            # SIGTERM aimed MID-superstep: a watcher thread fires the
+            # instant the main thread is inside the step's critical
+            # section (checkpoint._CRITICAL > 0 — typically while the
+            # K-iteration scan dispatch executes), so the handler MUST
+            # defer the final checkpoint to the completed K-boundary —
+            # never a half-applied carry
+            from mxnet_tpu.resilience import checkpoint as _ckm
+            def _watch():
+                while _ckm._CRITICAL[0] == 0:
+                    _time.sleep(0.0002)
+                os.kill(os.getpid(), _signal.SIGTERM)
+            _threading.Thread(target=_watch, daemon=True).start()
         ls = sstep.step(xs, ys, 8)
         losses.extend(float(v) for v in
                       np.asarray(ls.data, dtype=np.float32))
@@ -392,7 +409,8 @@ print("DONE steps", start, "->", STEPS)
 
 
 def _run_child(tmp_path, mode, ckpt_env, superstep=0, fp16=False,
-               opt="adam", chaos_spec=None, expect_rc=0):
+               opt="adam", chaos_spec=None, expect_rc=0, kill_mid=0,
+               steps=12):
     env = {k: v for k, v in os.environ.items() if k != "MXTPU_CHAOS"}
     env["MXTPU_CHECKPOINT"] = ckpt_env
     if chaos_spec:
@@ -400,7 +418,8 @@ def _run_child(tmp_path, mode, ckpt_env, superstep=0, fp16=False,
     res = subprocess.run(
         [sys.executable, "-c",
          _CHILD.format(root=ROOT, mode=mode, superstep=superstep,
-                       fp16=fp16, opt=opt)],
+                       fp16=fp16, opt=opt, kill_mid=kill_mid,
+                       steps=steps)],
         env=env, capture_output=True, text=True, timeout=300)
     assert res.returncode == expect_rc, (
         f"child rc={res.returncode} (wanted {expect_rc})\n"
@@ -438,6 +457,41 @@ def test_kill_and_resume_subprocess(tmp_path, superstep, fp16, opt):
     assert resilience.verify(f"{tmp_path}/ck") == []
     # leg 3: resume from the committed checkpoint
     res = _run_child(tmp_path, "resume", ck, superstep, fp16, opt)
+    losses_full, hash_full = _parse(full)
+    losses_res, hash_res = _parse(res)
+    assert losses_full == losses_res, (losses_full, losses_res)
+    assert hash_full == hash_res
+
+
+def test_sigterm_mid_superstep_commits_at_k_boundary(tmp_path):
+    """ISSUE 11 satellite: SIGTERM arriving MID-``Superstep`` scan (a
+    self-armed timer fires while the K-iteration dispatch executes, so
+    the handler runs inside the step's critical section). The final
+    checkpoint must commit at the last COMPLETED K-boundary — step
+    divisible by K, params/opt-state/counts mutually consistent, never
+    a half-applied carry — and a fresh process resuming from it must
+    reproduce the uninterrupted run's loss tail bit-exactly."""
+    k, steps = 4, 20
+    ck = f"{tmp_path}/ck:1000"  # interval never fires; only the final
+    # leg 1: uninterrupted reference
+    full = _run_child(tmp_path, "full", f"{tmp_path}/ref:1000",
+                      superstep=k, steps=steps)
+    # leg 2: killed mid-scan by the in-child timer
+    _run_child(tmp_path, "full", ck, superstep=k, steps=steps,
+               kill_mid=1, expect_rc=-signal.SIGTERM)
+    assert resilience.verify(f"{tmp_path}/ck") == []
+    ckpts = resilience.list_checkpoints(f"{tmp_path}/ck")
+    assert len(ckpts) == 1, ckpts
+    committed_step = ckpts[0][0]
+    # the contract under test: a K-boundary commit, not mid-carry —
+    # and an INTERIOR one (the timer aimed at superstep 3 of 4), so
+    # the resume leg has real steps left to reproduce
+    assert committed_step % k == 0, (committed_step, k)
+    assert 0 < committed_step < steps, (committed_step, steps)
+    man = json.load(open(os.path.join(ckpts[0][1], "MANIFEST.json")))
+    assert man["reason"] == "sigterm"
+    # leg 3: resume; the loss tail and final state hash must match
+    res = _run_child(tmp_path, "resume", ck, superstep=k, steps=steps)
     losses_full, hash_full = _parse(full)
     losses_res, hash_res = _parse(res)
     assert losses_full == losses_res, (losses_full, losses_res)

@@ -4,11 +4,16 @@ for any ``mxnet_tpu.resilience`` checkpoint directory.
 
     python tools/verify_checkpoint.py <ckpt_root_or_step_dir> [...]
     python tools/verify_checkpoint.py --all <ckpt_root>
+    python tools/verify_checkpoint.py --from-json <descriptor.json> [...]
 
 Exit code 0 = every checked checkpoint verified; 1 = problems found
 (each printed). ``--all`` checks every committed step under a root,
 not just the latest — the pre-flight for "can I actually resume from
-this directory" before tearing down the old pool.
+this directory" before tearing down the old pool. ``--from-json``
+verifies IN-MEMORY snapshot descriptors instead (the
+``mxtpu-snapshot-v1`` JSON a runtime elastic resize hands over —
+``resilience.elastic.ElasticTrainer.dump_descriptor``): manifest
+self-consistency + opt-state completeness, no payload on disk.
 
 The checks (shared with ``resilience.checkpoint.verify`` — the loader
 enforces the same invariants at restore time):
@@ -60,19 +65,53 @@ def _check_one(path):
     return True
 
 
+def _check_descriptor(path):
+    from mxnet_tpu.resilience import checkpoint as ck
+
+    label = os.path.relpath(path)
+    try:
+        with open(path) as f:
+            desc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"FAIL {label}")
+        print(f"  - unreadable descriptor: {e}")
+        return False
+    problems = ck.verify_descriptor(desc)
+    if problems:
+        print(f"FAIL {label}")
+        for p in problems:
+            print(f"  - {p}")
+        return False
+    topo = desc.get("topology") or {}
+    print(f"OK   {label}: step {desc.get('step')} "
+          f"({len(desc.get('tensors', {}))} chunks, "
+          f"reason={desc.get('reason')!r}, "
+          f"{topo.get('from_devices')}->{topo.get('to_devices')} devices)")
+    return True
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="verify mxnet_tpu checkpoint integrity")
     ap.add_argument("paths", nargs="+",
-                    help="checkpoint roots or step_* dirs")
+                    help="checkpoint roots or step_* dirs (or snapshot "
+                         "descriptor JSON files with --from-json)")
     ap.add_argument("--all", action="store_true",
                     help="check every committed step under each root, "
                          "not just the latest")
+    ap.add_argument("--from-json", action="store_true",
+                    help="paths are in-memory snapshot DESCRIPTOR json "
+                         "files (mxtpu-snapshot-v1, the elastic-resize "
+                         "handoff record), not checkpoint dirs")
     args = ap.parse_args(argv)
 
     from mxnet_tpu.resilience import checkpoint as ck
 
     ok = True
+    if args.from_json:
+        for path in args.paths:
+            ok = _check_descriptor(path) and ok
+        return 0 if ok else 1
     for path in args.paths:
         targets = [path]
         if args.all and not os.path.exists(os.path.join(path, ck.MANIFEST)):
