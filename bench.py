@@ -1062,6 +1062,191 @@ def bench_input_pipeline(backend):
         f.write("\n")
 
 
+_SERVE_PROBE = """
+import json, sys, time
+t0 = time.perf_counter()
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {root!r})
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import InferenceEngine
+net = nn.HybridSequential()
+net.add(nn.Dense(64, activation="relu", flatten=False, in_units=32))
+net.add(nn.Dense(16, flatten=False, in_units=64))
+net.initialize(init=mx.initializer.Xavier())
+eng = InferenceEngine(net, shapes=[(8, 32), (16, 32)], max_batch=8,
+                      max_wait_ms=1.0, name="probe")
+out = eng.predict(np.ones((8, 32), np.float32), timeout=120.0)
+dt = time.perf_counter() - t0
+eng.close()
+print(json.dumps({{"first_request_s": round(dt, 3),
+                   "hits": int(obs.COMPILE_CACHE_HITS.total()),
+                   "misses": int(obs.COMPILE_CACHE_MISSES.total())}}))
+"""
+
+
+def _bench_serve_cold_warm():
+    """Cold vs warm deploy-to-first-result: the same serving process
+    (deploy = AOT bucket compiles, then one request) run twice against
+    one persistent MXTPU_COMPILE_CACHE dir. The warm run's compiles are
+    disk reads — zero cache misses — so restart/redeploy cost is
+    tracing, not XLA. Same retry shape as ``_bench_compile_cache``."""
+    import subprocess
+    import tempfile
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="mxtpu_serve_bench_") as d:
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith("BENCH_")}
+        env["MXTPU_COMPILE_CACHE"] = d
+        attempts = 3
+        for phase in ("cold", "warm"):
+            for attempt in range(1, attempts + 1):
+                res = None
+                try:
+                    res = subprocess.run(
+                        [sys.executable, "-c",
+                         _SERVE_PROBE.format(root=root)],
+                        env=env, capture_output=True, text=True,
+                        timeout=240)
+                    out[phase] = json.loads(
+                        res.stdout.strip().splitlines()[-1])
+                    break
+                except Exception as e:
+                    detail = f"{type(e).__name__}: {e}"[:200]
+                    if res is not None and res.stderr:
+                        detail += " | probe stderr: " \
+                            + res.stderr.strip()[-300:]
+                    print(f"# serving {phase} probe attempt "
+                          f"{attempt} failed: {detail}",
+                          file=sys.stderr, flush=True)
+                    out[phase] = None
+                    if attempt < attempts:
+                        time.sleep(2.0 * attempt)
+    return out
+
+
+def bench_serving(backend):
+    """PR13 tentpole: production inference serving. Ragged synthetic
+    traffic through a sealed shape-bucket InferenceEngine, two legs:
+    (a) continuous batching — all requests submitted async, the
+    scheduler packs them into padded bucket batches; (b) the single-
+    request baseline — submit, wait, submit (batch window 0). Contract:
+    batched QPS > single QPS and ZERO recompiles after warmup (the
+    sealed-engine invariant the tier-1 smoke asserts). Also measures
+    cold-vs-warm deploy-to-first-result through the persistent compile
+    cache. Emits BENCH_pr13.json."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import InferenceEngine
+
+    feat = int(os.environ.get("BENCH_SERVE_FEAT", "32"))
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
+    n_reqs = int(os.environ.get(
+        "BENCH_SERVE_REQS", "240" if backend == "cpu" else "512"))
+    wait_ms = float(os.environ.get("BENCH_SERVE_WAIT_MS", "5"))
+    n_single = max(16, n_reqs // 4)
+    buckets = [(8, feat), (16, feat), (32, feat)]
+
+    def build():
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(64, activation="relu", flatten=False,
+                         in_units=feat))
+        net.add(nn.Dense(16, flatten=False, in_units=64))
+        net.initialize(init=mx.initializer.Xavier())
+        return net
+
+    # ragged traffic: sequence lengths drawn across all three buckets
+    rng = np.random.RandomState(0)
+    lengths = rng.choice([3, 5, 8, 11, 16, 21, 27, 32], size=n_reqs)
+    rows = [rng.rand(int(t), feat).astype(np.float32) for t in lengths]
+
+    # leg (a): continuous batching under a burst of async submits
+    eng = InferenceEngine(build(), buckets, max_batch=max_batch,
+                          max_wait_ms=wait_ms, queue_cap=n_reqs + 8,
+                          name="bench")
+    compiles_sealed = eng.stats()["compiles"]
+    for r in rows[:4]:
+        eng.predict(r, timeout=120.0)  # traffic warmup
+    t0 = time.perf_counter()
+    futs = [eng.submit(r) for r in rows]
+    for f in futs:
+        f.result(timeout=300.0)
+    batched_qps = n_reqs / (time.perf_counter() - t0)
+    st = eng.stats()
+    recompiles = st["compiles"] - compiles_sealed
+    eng.close()
+
+    # leg (b): single-request baseline — no batching window, serial
+    eng1 = InferenceEngine(build(), buckets, max_batch=max_batch,
+                           max_wait_ms=0.0, queue_cap=64,
+                           name="bench_single")
+    for r in rows[:2]:
+        eng1.predict(r, timeout=120.0)
+    t0 = time.perf_counter()
+    for r in rows[:n_single]:
+        eng1.predict(r, timeout=120.0)
+    single_qps = n_single / (time.perf_counter() - t0)
+    eng1.close()
+
+    first = _bench_serve_cold_warm()
+    speedup = batched_qps / single_qps if single_qps else None
+    tag = f"b{max_batch}_feat{feat}_{backend}"
+    _emit(f"serving_batched_{tag}", batched_qps, "req/sec", None,
+          requests=n_reqs, p50_ms=st["latency_p50_ms"],
+          p99_ms=st["latency_p99_ms"],
+          mean_batch_fill=st["mean_batch_fill"], batches=st["batches"],
+          recompiles_after_warmup=recompiles,
+          speedup_vs_single=round(speedup, 3) if speedup else None,
+          mfu_reason="serving scenario measures request throughput, "
+                     "not device FLOPs")
+    _emit(f"serving_single_{tag}", single_qps, "req/sec", None,
+          requests=n_single,
+          mfu_reason="serving scenario measures request throughput, "
+                     "not device FLOPs")
+    for phase in ("cold", "warm"):
+        rec = first.get(phase)
+        if rec:
+            _emit(f"serving_first_request_{phase}_{backend}",
+                  rec["first_request_s"], "sec", None,
+                  cache_hits=rec["hits"], cache_misses=rec["misses"],
+                  mfu_reason="deploy-to-first-result wall time, not "
+                             "device FLOPs")
+
+    out_path = os.environ.get(
+        "BENCH_PR13_OUT",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_pr13.json"))
+    with open(out_path, "w") as f:
+        json.dump({"scenario": "serving", "backend": backend,
+                   "config": {"feat": feat, "max_batch": max_batch,
+                              "requests": n_reqs,
+                              "single_requests": n_single,
+                              "max_wait_ms": wait_ms,
+                              "buckets": [list(b) for b in buckets]},
+                   "batched_qps": round(batched_qps, 2),
+                   "single_qps": round(single_qps, 2),
+                   "batched_speedup": round(speedup, 3) if speedup
+                   else None,
+                   "p50_ms": st["latency_p50_ms"],
+                   "p99_ms": st["latency_p99_ms"],
+                   "mean_batch_fill": st["mean_batch_fill"],
+                   "recompiles_after_warmup": recompiles,
+                   "first_request": first,
+                   "flops_per_step": None, "mfu": None,
+                   "mfu_reason": "serving scenario measures request "
+                                 "throughput, not device FLOPs"},
+                  f, indent=2)
+        f.write("\n")
+
+
 def bench_allreduce(backend):
     import jax
     import jax.numpy as jnp
@@ -1566,6 +1751,7 @@ def main():
              ("checkpoint", bench_checkpoint),
              ("amp", bench_amp),
              ("input_pipeline", bench_input_pipeline),
+             ("serving", bench_serving),
              ("bert", bench_bert),
              ("resnet", bench_resnet)]  # resnet LAST: tail = headline
     completed, failed = [], {}

@@ -64,6 +64,7 @@ from . import numpy_extension as npx  # noqa: F401
 from . import amp  # noqa: F401
 from . import contrib  # noqa: F401
 from . import models  # noqa: F401
+from . import serving  # noqa: F401
 from . import engine  # noqa: F401
 from . import attribute  # noqa: F401
 from .attribute import AttrScope  # noqa: F401

@@ -133,6 +133,30 @@ class QuantizedNet:
             raw = qops.dequantize(raw, *qrange)
         return NDArray(raw)
 
+    def aot_predict_fn(self, ctx=None, dtype="float32", sample_shape=None):
+        """AOT export hook (``mxnet_tpu.serving``) — the int8 mirror of
+        ``HybridBlock.aot_predict_fn``. The calibrated stage payloads
+        (int8 weights, ranges) are closure constants of the trace, so
+        ``param_raws`` is empty and the whole pipeline lowers to one
+        executable per shape bucket like any float block."""
+        del ctx, dtype, sample_shape  # stages are already materialized
+        from ..gluon import block as _block
+
+        def fn(param_raws, input_raw):
+            del param_raws
+            # excluded float stages call gluon layers; run them eagerly
+            # into this trace instead of through their own CachedOp
+            _block._TRACE_STATE.active = True
+            try:
+                raw, qrange = self._run(self._stages, input_raw, None)
+                if qrange is not None:
+                    raw = qops.dequantize(raw, *qrange)
+                return raw
+            finally:
+                _block._TRACE_STATE.active = False
+
+        return fn, []
+
     def _run(self, stages, raw, qrange):
         # (mn, mx) != None marks raw as LIVE int8 with that float range:
         # relu/pool/flatten/bn/residual-add then run their quantized_*

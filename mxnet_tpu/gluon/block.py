@@ -312,6 +312,24 @@ def _in_cached_trace():
     return getattr(_TRACE_STATE, "active", False)
 
 
+def signature_causes(old_sig, new_sig):
+    """Why an input signature changed: diff two ``((shape, dtype), ...)``
+    tuples into cause labels (``arity`` / ``shape`` / ``dtype``). Shared
+    by ``_CachedGraph._retrace_cause`` and the serving engine's sealed
+    no-retrace refusal (``mxnet_tpu.serving``), so both name a recompile
+    trigger the same way."""
+    causes = []
+    if old_sig != new_sig:
+        if len(old_sig) != len(new_sig):
+            causes.append("arity")
+        else:
+            if any(o[0] != n[0] for o, n in zip(old_sig, new_sig)):
+                causes.append("shape")
+            if any(o[1] != n[1] for o, n in zip(old_sig, new_sig)):
+                causes.append("dtype")
+    return causes
+
+
 class HybridBlock(Block):
     """Block that can be hybridized: traced once, compiled by XLA, replayed.
 
@@ -419,6 +437,62 @@ class HybridBlock(Block):
         finally:
             if saved is not None:
                 _restore_training_state(params, trainer, saved)
+
+    def aot_predict_fn(self, ctx=None, dtype="float32", sample_shape=None):
+        """AOT export hook (``mxnet_tpu.serving``): this block's
+        inference forward as a PURE function, suitable for
+        ``jax.jit(fn).lower(params, x).compile()`` — ahead-of-time
+        compilation to one executable per declared shape bucket.
+
+        Returns ``(fn, param_raws)`` where ``fn(param_raws, input_raw)``
+        replays the forward in predict mode (no autograd tape, dropout
+        off, BatchNorm on running stats) and returns the raw output (or
+        a tuple for multi-output blocks). ``param_raws`` are the current
+        parameter buffers in the same fixed (sorted-name) order —
+        device-resident, passed per call so a live weight swap never
+        needs a recompile, and never donated (the engine reuses them on
+        every request).
+
+        Inference is deterministic: the trace binds a FIXED PRNG key, and
+        parameter mutations inside the forward (there are none in
+        predict mode for the built-in layers) are dropped, not threaded
+        out. ``sample_shape`` (full shape, batch dim included) resolves
+        deferred-init parameters with one tiny eager pass, exactly like
+        ``warmup``.
+        """
+        from ..context import current_context
+
+        ctx = ctx or current_context()
+        params = [p for _, p in sorted(self.collect_params().items())]
+        if sample_shape is not None and any(p._data is None for p in params):
+            x0 = NDArray(jnp.zeros(tuple(sample_shape), dtype), ctx=ctx)
+            with autograd.predict_mode():
+                self(x0)
+            params = [p for _, p in sorted(self.collect_params().items())]
+        handles = [p.data(ctx) for p in params]
+
+        def fn(param_raws, input_raw):
+            _TRACE_STATE.active = True
+            _random.push_trace_key(jax.random.PRNGKey(0))
+            saved = [h._data_ for h in handles]
+            saved_ver = [h._version for h in handles]
+            try:
+                for h, raw in zip(handles, param_raws):
+                    h._data_ = raw
+                    h._version += 1
+                with autograd._RecordingStateScope(False, False):
+                    outs = self._eager_forward(NDArray(input_raw, ctx=ctx))
+                if isinstance(outs, NDArray):
+                    return outs.data
+                return tuple(o.data for o in outs)
+            finally:
+                for h, s, v in zip(handles, saved, saved_ver):
+                    h._data_ = s
+                    h._version = v
+                _random.pop_trace_key()
+                _TRACE_STATE.active = False
+
+        return fn, [h.data for h in handles]
 
     def infer_shape(self, *args):
         """Set shapes of this block's deferred params from input shapes.
@@ -625,15 +699,7 @@ class _CachedGraph:
             return None
         o_sig, o_train, o_rec, o_tracked, o_fused, o_amp = self._last_key
         n_sig, n_train, n_rec, n_tracked, n_fused, n_amp = new_key
-        causes = []
-        if o_sig != n_sig:
-            if len(o_sig) != len(n_sig):
-                causes.append("arity")
-            else:
-                if any(o[0] != n[0] for o, n in zip(o_sig, n_sig)):
-                    causes.append("shape")
-                if any(o[1] != n[1] for o, n in zip(o_sig, n_sig)):
-                    causes.append("dtype")
+        causes = signature_causes(o_sig, n_sig)
         if o_train != n_train:
             causes.append("training")
         if o_rec != n_rec:

@@ -80,6 +80,36 @@ def pad_batch(batch, batch_size):
     return padded, NDArray(mask)
 
 
+def pad_to_shape(arr, shape, pad_value=0):
+    """Pad ``arr`` (trailing-edge, any number of axes) up to ``shape``.
+
+    The general-rank sibling of :class:`SequenceBucketer`: the serving
+    batcher uses it to lift each request's rows onto its shape bucket
+    before stacking, so ragged traffic reaches the engine in at most
+    ``len(buckets)`` shapes. Rank mismatches and dimensions LARGER than
+    the target raise (implicit truncation would silently change the
+    math, same contract as ``bucket_for``).
+    """
+    raw = arr.data if isinstance(arr, NDArray) else _np.asarray(arr)
+    shape = tuple(int(s) for s in shape)
+    if raw.ndim != len(shape):
+        raise MXNetError(
+            f"pad_to_shape: rank {raw.ndim} input cannot pad to {shape}")
+    if any(d > t for d, t in zip(raw.shape, shape)):
+        raise MXNetError(
+            f"pad_to_shape: input shape {tuple(raw.shape)} exceeds target "
+            f"{shape}; add a bucket (truncation is never implicit)")
+    if tuple(raw.shape) == shape:
+        return arr
+    pad_width = [(0, t - d) for d, t in zip(raw.shape, shape)]
+    if isinstance(arr, NDArray):
+        import jax.numpy as jnp
+
+        return NDArray(jnp.pad(arr.data, pad_width,
+                               constant_values=pad_value), ctx=arr.ctx)
+    return _np.pad(raw, pad_width, constant_values=pad_value)
+
+
 class SequenceBucketer:
     """Pad variable-length sequences to a fixed set of bucket lengths.
 

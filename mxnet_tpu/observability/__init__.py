@@ -147,7 +147,7 @@ XLA_DISPATCH_TOTAL = _REGISTRY.counter(
     "mxtpu_xla_dispatch_total",
     "compiled-executable invocations, by site (op / cachedop_fwd / "
     "cachedop_bwd / kv_grouped / kv_bucket / trainer_fused / "
-    "superstep / superstep_stage)")
+    "superstep / superstep_stage / serving)")
 
 FUSED_FALLBACK_TOTAL = _REGISTRY.counter(
     "mxtpu_fused_fallback_total",
@@ -361,6 +361,52 @@ DONATION_UNALIASED_TOTAL = _REGISTRY.counter(
     "executables that donated buffers but aliased 0 bytes — the "
     "donation silently failed (also warned once per site)")
 
+# -- inference serving SLOs (mxnet_tpu/serving) ----------------------------
+
+SERVE_REQUESTS_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_requests_total",
+    "serving requests by model and terminal code (ok / shed / timeout / "
+    "too_large / error / closed) — the SLO numerator/denominator pair")
+SERVE_LATENCY_SECONDS = _REGISTRY.histogram(
+    "mxtpu_serving_latency_seconds",
+    "end-to-end request latency (submit -> result ready), by model — "
+    "p50/p99 via Histogram.quantile / histogram_quantile")
+SERVE_QUEUE_DEPTH = _REGISTRY.gauge(
+    "mxtpu_serving_queue_depth",
+    "requests waiting in the continuous-batching queue, by model "
+    "(sampled at each batch dispatch; sustained depth near the bound "
+    "means load-shedding is imminent)")
+SERVE_BATCH_FILL = _REGISTRY.histogram(
+    "mxtpu_serving_batch_fill",
+    "valid-row fraction of each dispatched batch, by model (sum/count "
+    "gives mean fill; low fill under load means max-wait is too short "
+    "or buckets too fragmented)",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+SERVE_BATCHES_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_batches_total",
+    "batches dispatched to a bucket executable, by model and bucket")
+SERVE_SHED_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_shed_total",
+    "requests rejected at submit because the bounded queue was full "
+    "(backpressure / load shedding), by model")
+SERVE_TIMEOUT_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_timeout_total",
+    "requests whose deadline expired before dispatch (typed timeout — "
+    "never a stale result), by model")
+SERVE_COMPILE_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_compile_total",
+    "AOT bucket-executable compiles at deploy time, by model — FLAT "
+    "after seal(); any increase after warmup is a no-retrace-contract "
+    "violation")
+SERVE_LIVE_MODELS = _REGISTRY.gauge(
+    "mxtpu_serving_live_models",
+    "model versions currently live in the ModelRepository")
+SERVE_SWAPS_TOTAL = _REGISTRY.counter(
+    "mxtpu_serving_swaps_total",
+    "repository version transitions, by model and outcome (committed / "
+    "rolled_back / aborted — aborted = staged load failed verification "
+    "and never became visible)")
+
 # -- in-scan superstep device metrics (per-iteration, K-slot series) -------
 
 SUPERSTEP_ITER_LOSS = _REGISTRY.series_gauge(
@@ -530,6 +576,66 @@ def record_h2d(nbytes: int, dt: float, depth: int):
     DATA_PREFETCH_QUEUE_DEPTH.set(depth)
     _TRACER.record("data.h2d", cat="io", ts=_time.perf_counter() - dt,
                    dur=dt, args={"bytes": nbytes, "queue_depth": depth})
+
+
+def record_serve_batch(model: str, bucket, n_valid: int, capacity: int,
+                       dt: float, depth: int):
+    """One continuous-batching dispatch (mxnet_tpu/serving): batch-fill
+    + queue-depth accounting and the per-batch trace span."""
+    fill = n_valid / max(capacity, 1)
+    SERVE_BATCHES_TOTAL.inc(1, model=model, bucket=str(bucket))
+    SERVE_BATCH_FILL.observe(fill, model=model)
+    SERVE_QUEUE_DEPTH.set(depth, model=model)
+    _TRACER.record("serving.batch", cat="serving",
+                   ts=_time.perf_counter() - dt, dur=dt,
+                   args={"model": model, "bucket": str(bucket),
+                         "n_valid": int(n_valid), "capacity": int(capacity),
+                         "fill": round(fill, 4), "queue_depth": int(depth)})
+
+
+def record_serve_request(model: str, code: str, latency=None):
+    """Terminal accounting for one serving request. ``code`` is the
+    typed outcome (ok / shed / timeout / too_large / error / closed);
+    ``latency`` (submit -> result, seconds) only accompanies ok."""
+    SERVE_REQUESTS_TOTAL.inc(1, model=model, code=code)
+    if latency is not None:
+        SERVE_LATENCY_SECONDS.observe(latency, model=model)
+    if code == "shed":
+        SERVE_SHED_TOTAL.inc(1, model=model)
+        _TRACER.instant("serving.shed", cat="serving", model=model)
+    elif code == "timeout":
+        SERVE_TIMEOUT_TOTAL.inc(1, model=model)
+        _TRACER.instant("serving.timeout", cat="serving", model=model)
+
+
+def record_serve_swap(model: str, outcome: str, version=None,
+                      prev_version=None):
+    """One ModelRepository version transition (committed / rolled_back /
+    aborted)."""
+    SERVE_SWAPS_TOTAL.inc(1, model=model, outcome=outcome)
+    _TRACER.instant("serving.swap", cat="serving", model=model,
+                    outcome=outcome, version=str(version),
+                    prev_version=str(prev_version))
+
+
+def serve_slo_snapshot(model: str) -> dict:
+    """p50/p99 latency + request/batch counters for ``model`` as plain
+    floats (reads the histograms — off the hot path by construction)."""
+    p50 = SERVE_LATENCY_SECONDS.quantile(0.5, model=model)
+    p99 = SERVE_LATENCY_SECONDS.quantile(0.99, model=model)
+    n = SERVE_BATCH_FILL.value(model=model)
+    return {
+        "model": model,
+        "requests_ok": SERVE_REQUESTS_TOTAL.value(model=model, code="ok"),
+        "latency_p50_s": p50,
+        "latency_p99_s": p99,
+        "latency_count": SERVE_LATENCY_SECONDS.value(model=model),
+        "batches": n,
+        "mean_batch_fill": (SERVE_BATCH_FILL.sum(model=model) / n) if n else None,
+        "shed": SERVE_SHED_TOTAL.value(model=model),
+        "timeouts": SERVE_TIMEOUT_TOTAL.value(model=model),
+        "compiles": SERVE_COMPILE_TOTAL.value(model=model),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -162,6 +162,28 @@ class Histogram(Metric):
     def total(self) -> float:
         return sum(rec[-1] for rec in self._values.values())
 
+    def quantile(self, q: float, **labels):
+        """Estimated q-quantile (0..1) for the label set, interpolated
+        linearly inside the containing bucket (Prometheus
+        ``histogram_quantile`` semantics). ``None`` with no observations;
+        observations beyond the last finite bucket clamp to it — the
+        serving SLO report reads p50/p99 through this."""
+        if not 0.0 <= q <= 1.0:
+            raise MXNetError(f"quantile {q} outside [0, 1]")
+        rec = self._values.get(_label_key(labels))
+        if not rec or rec[-1] <= 0:
+            return None
+        rank = q * rec[-1]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            prev_cum = cum
+            cum += rec[i]
+            if cum >= rank:
+                lo = self.buckets[i - 1] if i else 0.0
+                frac = (rank - prev_cum) / rec[i] if rec[i] else 1.0
+                return lo + (b - lo) * frac
+        return self.buckets[-1]
+
     def expose(self) -> list:
         lines = []
         if self.help:

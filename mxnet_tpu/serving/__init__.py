@@ -1,0 +1,42 @@
+"""``mxnet_tpu.serving`` — production inference serving.
+
+The inference half of the north star (the role MXNet 1.x's C predict
+API + model-server heritage played), built on the training stack's own
+primitives:
+
+- :class:`InferenceEngine` — AOT shape-bucket executables
+  (``jax.jit(...).lower().compile()`` at deploy time, warmed through
+  ``MXTPU_COMPILE_CACHE``), sealed with a hard no-retrace contract, fed
+  by a continuous-batching scheduler (``SequenceBucketer`` selection +
+  ``pad_batch`` fill, per-request deadlines, bounded-queue load shed);
+- :class:`ModelRepository` — many named+versioned models on one
+  device; staged load -> warmup -> atomic pointer flip (the PR-8
+  checkpoint commit protocol in-memory), drain, instant rollback;
+- serving SLOs on the observability registry (p50/p99 latency,
+  batch-fill, queue depth, shed/timeout counters — scrapeable via
+  ``observability.serve_metrics``; ``tools/telemetry_report.py`` has a
+  Serving section).
+
+Knobs: ``MXTPU_SERVE_MAX_BATCH`` / ``MXTPU_SERVE_MAX_WAIT_MS`` /
+``MXTPU_SERVE_QUEUE`` (docs/env_vars.md); recipe: docs/serving.md.
+"""
+
+from __future__ import annotations
+
+from .batcher import ContinuousBatcher, ServeFuture  # noqa: F401
+from .engine import (  # noqa: F401
+    InferenceEngine,
+    serve_max_batch,
+    serve_max_wait_ms,
+    serve_queue_cap,
+)
+from .errors import (  # noqa: F401
+    EngineClosed,
+    RequestTimeout,
+    RequestTooLarge,
+    RetraceForbidden,
+    ServerOverloaded,
+    ServingError,
+    StagedLoadError,
+)
+from .repository import ModelRepository  # noqa: F401

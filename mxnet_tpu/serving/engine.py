@@ -1,0 +1,432 @@
+"""InferenceEngine: AOT shape-bucket executables + continuous batching.
+
+Deploy path (all the compilation happens HERE, never per request):
+
+1. ``net.aot_predict_fn()`` (HybridBlock, or a calibrated
+   ``QuantizedNet`` for int8) gives the pure inference function;
+2. ``jax.jit(fn).lower(params, batch).compile()`` builds ONE executable
+   per declared shape bucket — ahead of time, warmed through the
+   persistent compile cache (``MXTPU_COMPILE_CACHE``), each warmed with
+   one throwaway batch so request 1 runs at steady state;
+3. the engine SEALS: retrace budget is zero. A request whose signature
+   matches no bucket is refused loudly with a typed
+   :class:`RetraceForbidden` naming the cause
+   (``gluon.block.signature_causes`` — the CachedGraph's retrace-cause
+   machinery), never compiled for.
+
+Weights stay device-resident and are passed to the executable per call
+(NEVER donated — the same buffers serve every request, and a live
+``ModelRepository`` swap just hands the next engine its own buffers).
+
+Request path: ``submit()`` pads the request's rows onto its bucket
+(``shape_guard.pad_to_shape`` / ``SequenceBucketer`` selection) and
+queues it; the :class:`ContinuousBatcher` scheduler groups requests per
+bucket and ``_execute`` stacks them, pads the partial batch to
+``max_batch`` with ``shape_guard.pad_batch``, runs the ONE matching
+executable, and unpads on the way out — only the validity prefix of the
+batch axis is ever returned, pad rows never leak into results.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as _np
+
+from .. import observability as _obs
+from ..base import MXNetError, getenv
+from ..observability import flight as _flight
+from ..observability.metrics import Histogram as _Histogram
+from .batcher import ContinuousBatcher, ServeFuture, _Request
+from .errors import (
+    EngineClosed,
+    RequestTooLarge,
+    RetraceForbidden,
+    ServerOverloaded,
+)
+
+_MAX_BATCH_DEFAULT = 8
+_MAX_WAIT_MS_DEFAULT = 5.0
+_QUEUE_DEFAULT = 256
+
+
+def serve_max_batch() -> int:
+    """Batch capacity (rows) per dispatch, ``MXTPU_SERVE_MAX_BATCH``."""
+    return max(1, int(getenv("MXTPU_SERVE_MAX_BATCH", _MAX_BATCH_DEFAULT,
+                             dtype=int)))
+
+
+def serve_max_wait_ms() -> float:
+    """Longest a partial batch waits for fill before dispatching,
+    ``MXTPU_SERVE_MAX_WAIT_MS`` (the latency/throughput knob)."""
+    return float(getenv("MXTPU_SERVE_MAX_WAIT_MS", _MAX_WAIT_MS_DEFAULT,
+                        dtype=float))
+
+
+def serve_queue_cap() -> int:
+    """Bounded submit-queue depth (requests) before load shedding,
+    ``MXTPU_SERVE_QUEUE``."""
+    return max(1, int(getenv("MXTPU_SERVE_QUEUE", _QUEUE_DEFAULT,
+                             dtype=int)))
+
+
+class InferenceEngine:
+    """Serve one model version: sealed AOT executables behind a
+    continuous batcher.
+
+    ``shapes``: one per-ROW input shape (no batch dim) or a list of
+    them — the shape buckets, e.g. ``[(8, 16), (16, 16), (32, 16)]``
+    for ragged sequences. Shapes varying along exactly one axis get
+    :class:`SequenceBucketer` smallest-fitting-bucket selection; any
+    request row shape elementwise <= a bucket pads onto it.
+
+    >>> eng = InferenceEngine(net, shapes=[(16,), (32,)], max_batch=8)
+    >>> y = eng.predict(x)                  # sync, one row or a few
+    >>> fut = eng.submit(x, deadline_ms=50) # async with a deadline
+    >>> fut.result(), fut.version
+    """
+
+    def __init__(self, net, shapes, *, ctx=None, dtype="float32",
+                 max_batch=None, max_wait_ms=None, queue_cap=None,
+                 name="model", version="v1", autostart=True):
+        from ..context import current_context
+
+        self._name = str(name)
+        self._version = str(version)
+        self._ctx = ctx or current_context()
+        self._dtype = _np.dtype(dtype)
+        self._max_batch = int(max_batch) if max_batch is not None \
+            else serve_max_batch()
+        self._max_wait = (float(max_wait_ms) if max_wait_ms is not None
+                          else serve_max_wait_ms()) / 1e3
+        self._queue_cap = int(queue_cap) if queue_cap is not None \
+            else serve_queue_cap()
+        self._buckets = self._normalize_shapes(shapes)
+        self._rank = len(self._buckets[0])
+        self._bucketer = self._build_bucketer()
+        self._compiled = {}
+        self._single = True
+        self._params = None
+        self._fn = None
+        self._sealed = False
+        self._closed = False
+        self._paused = False
+        # engine-local SLO state: independent of the global telemetry
+        # switch, so stats()/bench read real numbers with telemetry off
+        self._latency = _Histogram("local_latency")
+        self._fill_sum = 0.0
+        self._batches = 0
+        self._requests_ok = 0
+        self._refused = 0
+        self._shed = 0
+        self._timeouts = 0
+        self._compiles = 0
+        self._deploy(net)
+        self._batcher = ContinuousBatcher(
+            self._execute, max_batch=self._max_batch,
+            max_wait=self._max_wait, queue_cap=self._queue_cap,
+            on_expire=self._on_expire, autostart=autostart)
+
+    # -- bucket geometry ---------------------------------------------------
+    @staticmethod
+    def _normalize_shapes(shapes):
+        if isinstance(shapes, tuple) or (
+                isinstance(shapes, list) and shapes and
+                not isinstance(shapes[0], (tuple, list))):
+            shapes = [shapes]
+        buckets = sorted({tuple(int(d) for d in s) for s in shapes},
+                         key=lambda b: (int(_np.prod(b)), b))
+        if not buckets or any(d <= 0 for b in buckets for d in b):
+            raise MXNetError(f"invalid serving shape buckets {shapes!r}")
+        if len({len(b) for b in buckets}) != 1:
+            raise MXNetError(
+                f"serving shape buckets must share one rank, got {buckets}")
+        return buckets
+
+    def _build_bucketer(self):
+        """Shapes varying along exactly one axis -> SequenceBucketer
+        selection on that axis (the ragged-sequence fast path)."""
+        from ..gluon.data.shape_guard import SequenceBucketer
+
+        if len(self._buckets) < 2:
+            return None
+        varying = [i for i in range(self._rank)
+                   if len({b[i] for b in self._buckets}) > 1]
+        if len(varying) != 1:
+            return None
+        return SequenceBucketer([b[varying[0]] for b in self._buckets],
+                                axis=varying[0])
+
+    def _bucket_for(self, row_shape):
+        """Smallest bucket every dim of ``row_shape`` fits in; typed
+        refusal (never a compile) when none does."""
+        if self._bucketer is not None:
+            ax = self._bucketer.axis
+            try:
+                target = self._bucketer.bucket_for(int(row_shape[ax]))
+            except MXNetError:
+                target = None
+            if target is not None:
+                cand = tuple(target if i == ax else d
+                             for i, d in enumerate(row_shape))
+                if cand in self._compiled:
+                    return cand
+        else:
+            fits = [b for b in self._buckets
+                    if all(d <= t for d, t in zip(row_shape, b))]
+            if fits:
+                return fits[0]  # buckets sorted smallest-first
+        self._refuse(row_shape)
+
+    def _refuse(self, row_shape, got_dtype=None):
+        from ..gluon.block import signature_causes
+
+        got_dtype = str(got_dtype or self._dtype)
+        closest = min(self._buckets,
+                      key=lambda b: sum(abs(d - t) for d, t in
+                                        zip(row_shape, b))
+                      if len(b) == len(row_shape) else float("inf"))
+        causes = signature_causes(
+            ((closest, str(self._dtype)),), ((tuple(row_shape), got_dtype),))
+        self._refused += 1
+        if _obs.ENABLED:
+            _obs.record_serve_request(self._name, "error")
+        raise RetraceForbidden(
+            f"sealed serving engine {self._name}:{self._version} has no "
+            f"executable for row signature {tuple(row_shape)}/{got_dtype} "
+            f"(cause: {'+'.join(causes) or 'unknown'}; retrace budget is 0 "
+            f"after warmup). Known buckets: {self._buckets} @ "
+            f"{self._dtype.name}. Pad/bucket the client input, or add a "
+            f"bucket and redeploy.")
+
+    # -- deploy (AOT compile, seal) ----------------------------------------
+    def _deploy(self, net):
+        import jax
+        import jax.numpy as jnp
+
+        if not hasattr(net, "aot_predict_fn"):
+            raise MXNetError(
+                f"{type(net).__name__} has no aot_predict_fn — serve a "
+                "HybridBlock (or contrib.quantization.QuantizedNet)")
+        fn, param_raws = net.aot_predict_fn(
+            ctx=self._ctx, dtype=self._dtype.name,
+            sample_shape=(1,) + self._buckets[0])
+        self._fn = fn
+        self._params = param_raws  # device-resident; reused, never donated
+        jfn = jax.jit(fn)
+        for bucket in self._buckets:
+            x = jnp.zeros((self._max_batch,) + bucket, self._dtype.name)
+            t0 = time.perf_counter()
+            compiled = jfn.lower(self._params, x).compile()
+            self._compiled[bucket] = compiled
+            self._compiles += 1
+            if _obs.ENABLED:
+                _obs.SERVE_COMPILE_TOTAL.inc(1, model=self._name)
+                _obs.tracer().record(
+                    "serving.compile", cat="serving",
+                    ts=t0, dur=time.perf_counter() - t0,
+                    args={"model": self._name, "version": self._version,
+                          "bucket": str(bucket)})
+            if _obs.introspect.ENABLED:
+                site = f"serving[{self._name}:{'x'.join(map(str, bucket))}]"
+                if not _obs.introspect.registered(site):
+                    _obs.introspect.register_jit(site, jfn,
+                                                 (self._params, x))
+            # warm execution: request 1 must run at steady state
+            out = compiled(self._params, x)
+            self._single = not isinstance(out, (tuple, list))
+            jax.block_until_ready(out)
+        self._sealed = True
+
+    # -- request path ------------------------------------------------------
+    def submit(self, x, deadline_ms=None, cast=True) -> ServeFuture:
+        """Queue one request (a single row, or a micro-batch with a
+        leading rows axis, ``rows <= max_batch``). Raises typed errors:
+        :class:`ServerOverloaded` (queue full), :class:`RequestTooLarge`,
+        :class:`RetraceForbidden` (no bucket), :class:`EngineClosed`.
+        ``deadline_ms``: drop (typed timeout) if not dispatched in time.
+        ``cast=False`` refuses dtype mismatches instead of converting."""
+        if self._closed or self._paused:
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "closed")
+            raise EngineClosed(
+                f"engine {self._name}:{self._version} is "
+                f"{'closed' if self._closed else 'paused (standby)'}")
+        arr = x.asnumpy() if hasattr(x, "asnumpy") else _np.asarray(x)
+        if not cast and arr.dtype != self._dtype:
+            self._refuse(arr.shape[1:] if arr.ndim == self._rank + 1
+                         else arr.shape, got_dtype=arr.dtype)
+        arr = _np.asarray(arr, self._dtype)
+        if arr.ndim == self._rank:
+            arr = arr[None]  # single row convenience
+        if arr.ndim != self._rank + 1 or arr.shape[0] < 1:
+            self._refuse(arr.shape)
+        rows = int(arr.shape[0])
+        if rows > self._max_batch:
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "too_large")
+            raise RequestTooLarge(
+                f"request carries {rows} rows > max_batch "
+                f"{self._max_batch} (MXTPU_SERVE_MAX_BATCH) — it can "
+                "never fit one dispatch; split it client-side")
+        bucket = self._bucket_for(arr.shape[1:])
+        if arr.shape[1:] != bucket:
+            from ..gluon.data.shape_guard import pad_to_shape
+
+            arr = pad_to_shape(arr, (rows,) + bucket)
+        deadline = None if deadline_ms is None else \
+            time.perf_counter() + float(deadline_ms) / 1e3
+        req = _Request(arr, rows, bucket, deadline=deadline)
+        req.version = self._version
+        try:
+            self._batcher.submit(req)
+        except ServerOverloaded:
+            self._shed += 1
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "shed")
+            raise
+        except EngineClosed:
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "closed")
+            raise
+        return ServeFuture(req)
+
+    def predict(self, x, timeout=None, deadline_ms=None):
+        """Synchronous request: submit + wait. Returns the host result
+        (numpy; tuple for multi-output nets), pad rows stripped."""
+        return self.submit(x, deadline_ms=deadline_ms).result(timeout)
+
+    def _on_expire(self, req):
+        self._timeouts += 1
+        if _obs.ENABLED:
+            _obs.record_serve_request(self._name, "timeout")
+
+    def _execute(self, bucket, reqs):
+        """Batcher dispatch hook (scheduler thread): stack the group,
+        pad to capacity, run the ONE sealed executable, unpad."""
+        from ..gluon.data.shape_guard import pad_batch
+
+        compiled = self._compiled.get(bucket)
+        if compiled is None:  # cannot happen post-seal; refuse, not trace
+            raise RetraceForbidden(
+                f"no executable for bucket {bucket} (engine sealed)")
+        stacked = _np.concatenate([r.payload for r in reqs], axis=0) \
+            if len(reqs) > 1 else reqs[0].payload
+        n_valid = int(stacked.shape[0])
+        padded = stacked
+        if n_valid < self._max_batch:
+            padded, _mask = pad_batch(stacked, self._max_batch)
+            # the mask's valid prefix is exactly rows [:n_valid] — the
+            # unpad below slices it; pad rows never reach a result
+        t0 = time.perf_counter()
+        if _flight.INSTALLED:
+            with _flight.dispatch("serving"):
+                out = compiled(self._params, padded)
+        else:
+            out = compiled(self._params, padded)
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("serving")
+        outs = (out,) if self._single else tuple(out)
+        # results leave the process as host payloads: ONE sync per batch
+        host = [_np.asarray(o) for o in outs]  # mxtpu-lint: host-sync-ok
+        dt = time.perf_counter() - t0
+        now = time.perf_counter()
+        off = 0
+        for r in reqs:
+            rows = [h[off:off + r.rows] for h in host]
+            off += r.rows
+            r.finish(result=rows[0] if self._single else tuple(rows))
+            self._requests_ok += 1
+            self._latency.observe(now - r.t_submit)
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "ok",
+                                          latency=now - r.t_submit)
+        self._batches += 1
+        self._fill_sum += n_valid / self._max_batch
+        if _obs.ENABLED:
+            _obs.record_serve_batch(self._name, bucket, n_valid,
+                                    self._max_batch, dt,
+                                    self._batcher.qsize())
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def version(self):
+        return self._version
+
+    @property
+    def buckets(self):
+        return list(self._buckets)
+
+    @property
+    def sealed(self):
+        return self._sealed
+
+    def stats(self) -> dict:
+        """Engine-local SLO snapshot (plain floats, works with global
+        telemetry off). ``compiles`` is flat after seal — the
+        zero-recompiles-after-warmup contract the bench asserts."""
+        p50 = self._latency.quantile(0.5)
+        p99 = self._latency.quantile(0.99)
+        return {
+            "model": self._name, "version": self._version,
+            "buckets": [list(b) for b in self._buckets],
+            "max_batch": self._max_batch,
+            "requests_ok": self._requests_ok,
+            "batches": self._batches,
+            "mean_batch_fill": (self._fill_sum / self._batches)
+            if self._batches else None,
+            "latency_p50_ms": None if p50 is None else p50 * 1e3,
+            "latency_p99_ms": None if p99 is None else p99 * 1e3,
+            "shed": self._shed, "timeouts": self._timeouts,
+            "refused": self._refused,
+            "compiles": self._compiles,
+            "retraces_after_warmup": 0 if self._sealed else None,
+            "queue_depth": self._batcher.qsize() if self._batcher else 0,
+        }
+
+    # -- lifecycle ---------------------------------------------------------
+    def pause(self):
+        """Stop accepting work and DRAIN in-flight requests, keeping the
+        executables and weights resident (repository standby — rollback
+        is ``resume()``, not a recompile)."""
+        if self._paused or self._closed:
+            return
+        self._paused = True
+        self._batcher.close()
+
+    def resume(self):
+        """Reactivate a paused standby engine (repository rollback)."""
+        if self._closed:
+            raise EngineClosed(f"engine {self._name}:{self._version} was "
+                               "released; reload instead of resume")
+        if not self._paused:
+            return
+        self._batcher = ContinuousBatcher(
+            self._execute, max_batch=self._max_batch,
+            max_wait=self._max_wait, queue_cap=self._queue_cap,
+            on_expire=self._on_expire)
+        self._paused = False
+
+    def close(self):
+        """Drain in-flight requests, then release: executables and
+        weight references dropped. Idempotent; matches the
+        DevicePrefetcher contract (errors propagate to waiters, safe
+        from ``__del__``)."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._batcher is not None:
+            self._batcher.close()
+        self._compiled = {}
+        self._params = None
+        self._fn = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,49 @@
+"""Typed serving errors — every way a request can fail has its own
+class, so front-ends map outcomes to response codes by type (load shed
+-> 503, deadline -> 504, refused shape -> 400) instead of parsing
+message strings. All subclass :class:`~mxnet_tpu.base.MXNetError`.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+
+class ServingError(MXNetError):
+    """Base class for every serving-layer failure."""
+
+
+class ServerOverloaded(ServingError):
+    """Load shed: the bounded request queue was full at submit time
+    (backpressure — the client should retry with backoff or reroute).
+    The request was REJECTED, never partially processed."""
+
+
+class RequestTimeout(ServingError):
+    """The request's deadline expired before its batch dispatched.
+    Typed — a deadline miss is never answered with a stale result."""
+
+
+class RequestTooLarge(ServingError):
+    """A single request carries more rows than ``max_batch`` — it can
+    never fit in one dispatch. Split it client-side (the engine never
+    splits implicitly: partial results are not a thing)."""
+
+
+class EngineClosed(ServingError):
+    """Submit after ``close()`` (or to a paused standby version).
+    In-flight requests at close time still complete — only NEW work is
+    refused."""
+
+
+class RetraceForbidden(ServingError):
+    """The sealed engine refused an input signature with no AOT
+    executable (retrace budget is 0 after warmup). The message names
+    the cause (shape/dtype/arity — ``gluon.block.signature_causes``)
+    and the known buckets; fix the client or add a bucket and
+    redeploy."""
+
+
+class StagedLoadError(ServingError):
+    """A staged model load failed build/warmup/verification. The stage
+    was discarded — the previous live version never stopped serving."""

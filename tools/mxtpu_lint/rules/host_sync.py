@@ -51,6 +51,15 @@ HOT_FUNCTIONS = [
     # SPMD mesh-side step
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.step"),
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.run_superstep"),
+    # serving: the continuous-batching scheduler loop and the per-batch
+    # execute hook (submit->result latency IS the SLO — a stray sync
+    # here serializes every request behind it)
+    ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._run"),
+    ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._sweep"),
+    ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._flush"),
+    ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._admit"),
+    ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._next_wake"),
+    ("mxnet_tpu/serving/engine.py", "InferenceEngine._execute"),
 ]
 
 #: int()/float() args that are NEVER device syncs: static shape

@@ -155,6 +155,66 @@ def render_superstep(events):
         f"(mean K = {steps / len(evs):.1f})"])
 
 
+def render_serving(events):
+    """Serving SLO summary from the ``serving.*`` trace series:
+    ``serving.batch`` spans (one per continuous-batching dispatch,
+    ``args``: model/bucket/n_valid/capacity/fill/queue_depth) joined
+    with the ``serving.shed`` / ``serving.timeout`` instants and
+    ``serving.swap`` version transitions. Same crash-proofing contract
+    as the AMP/roofline sections: absent series -> empty string,
+    malformed args render as '-' / count as zero."""
+    batches = [ev for ev in events if ev.get("name") == "serving.batch"]
+    sheds = sum(1 for ev in events if ev.get("name") == "serving.shed")
+    timeouts = sum(1 for ev in events
+                   if ev.get("name") == "serving.timeout")
+    compiles = sum(1 for ev in events
+                   if ev.get("name") == "serving.compile")
+    swaps = [ev for ev in events if ev.get("name") == "serving.swap"]
+    if not (batches or sheds or timeouts or compiles or swaps):
+        return ""
+
+    def num(ev, key):
+        args = ev.get("args")
+        v = args.get(key) if isinstance(args, dict) else None
+        return float(v) if isinstance(v, (int, float)) else None
+
+    def arg(ev, key):
+        args = ev.get("args")
+        return args.get(key, "-") if isinstance(args, dict) else "-"
+
+    lines = ["", "Serving:"]
+    # per-model dispatch stats from the batch spans
+    per_model = {}
+    for ev in batches:
+        per_model.setdefault(str(arg(ev, "model")), []).append(ev)
+    for model in sorted(per_model):
+        evs = per_model[model]
+        rows = sum(n for n in (num(e, "n_valid") for e in evs)
+                   if n is not None)
+        fills = [f for f in (num(e, "fill") for e in evs)
+                 if f is not None]
+        depths = [d for d in (num(e, "queue_depth") for e in evs)
+                  if d is not None]
+        fill = f"{sum(fills) / len(fills):.2f}" if fills else "-"
+        depth = f"{max(depths):.0f}" if depths else "-"
+        durs = [float(e.get("dur", 0.0)) / 1e3 for e in evs]
+        avg = f"{sum(durs) / len(durs):.3f}" if durs else "-"
+        lines.append(
+            f"  {model}: {len(evs)} batches, {int(rows)} requests, "
+            f"mean fill {fill}, peak queue depth {depth}, "
+            f"avg dispatch {avg} ms")
+    if sheds or timeouts:
+        lines.append(f"  shed: {sheds}, deadline timeouts: {timeouts}")
+    if compiles:
+        lines.append(f"  AOT bucket compiles: {compiles} "
+                     f"(flat after warmup by contract)")
+    for ev in swaps:
+        lines.append(
+            f"  swap [{arg(ev, 'model')}] {arg(ev, 'outcome')}: "
+            f"{arg(ev, 'prev_version')} -> {arg(ev, 'version')}")
+    return "\n".join(lines)
+
+
 #: cost-record site -> the span series whose mean duration times it
 #: (a superstep span covers K iterations — and so does its FLOP count,
 #: so the ratio is still per-invocation-consistent)
@@ -262,6 +322,9 @@ def main(argv=None):
     roof = render_roofline(events)
     if roof:
         print(roof)
+    serving = render_serving(events)
+    if serving:
+        print(serving)
     if args.steps:
         out = render_steps(events)
         if out:
