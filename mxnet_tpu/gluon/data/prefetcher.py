@@ -66,10 +66,20 @@ class DevicePrefetcher:
     ``DataBatch``) is preserved leaf-wise.
     """
 
+    #: machine-checked lock protocol (mxtpu-lint thread-guard): epoch
+    #: lifecycle state swaps only under the lifecycle lock — close()
+    #: racing _start_epoch() (consumer restart vs GC __del__, or an
+    #: elastic repartition) otherwise orphans a producer thread blocked
+    #: on a queue nobody drains
+    _GUARDED_BY = {"_thread": "_lifecycle_lock",
+                   "_queue": "_lifecycle_lock",
+                   "_stop": "_lifecycle_lock"}
+
     def __init__(self, source, device=None, mesh=None, depth=None,
                  batch_axis="dp"):
         if device is not None and mesh is not None:
             raise ValueError("pass device OR mesh, not both")
+        self._lifecycle_lock = threading.Lock()
         self._source = source
         self._device = device
         self._mesh = mesh
@@ -179,12 +189,13 @@ class DevicePrefetcher:
             self._source.reset()
         self._exhausted = False
         self._delivered = 0
-        self._stop = threading.Event()
-        self._queue = queue.Queue(maxsize=self._depth)
-        self._thread = threading.Thread(
-            target=self._produce, args=(self._queue, self._stop),
-            name="mxtpu-device-prefetch", daemon=True)
-        self._thread.start()
+        with self._lifecycle_lock:
+            self._stop = threading.Event()
+            self._queue = queue.Queue(maxsize=self._depth)
+            self._thread = threading.Thread(
+                target=self._produce, args=(self._queue, self._stop),
+                name="mxtpu-device-prefetch", daemon=True)
+            self._thread.start()
 
     # -- consumer protocol ------------------------------------------------
     def __iter__(self):
@@ -271,20 +282,25 @@ class DevicePrefetcher:
         self._exhausted = False
 
     def close(self):
-        """Idempotent shutdown: unblock and join the producer thread."""
-        thread = self.__dict__.get("_thread")
+        """Idempotent shutdown: unblock and join the producer thread.
+        The thread/queue swap out under the lifecycle lock; the drain
+        and JOIN run outside it (holding a lock across a join is the
+        deadlock shape the lock-order rule exists for)."""
+        if "_lifecycle_lock" not in self.__dict__:
+            return  # partially-constructed instance (GC during __init__)
+        with self._lifecycle_lock:
+            thread, q, stop = self._thread, self._queue, self._stop
+            self._thread = None
+            self._queue = None
         if thread is None:
             return
-        self._stop.set()
-        q = self._queue
+        stop.set()
         while True:  # drain so a producer blocked on put() wakes up
             try:
                 q.get_nowait()
             except queue.Empty:
                 break
         thread.join(timeout=5.0)
-        self._thread = None
-        self._queue = None
 
     def __del__(self):
         try:

@@ -227,10 +227,18 @@ class ResizeIter(DataIter):
 class PrefetchingIter(DataIter):
     """Background-thread prefetch (reference: ``PrefetchingIter``)."""
 
+    #: machine-checked lock protocol (mxtpu-lint thread-guard): the
+    #: started flag flips only under the close lock, so exactly ONE
+    #: closer signals and joins the prefetch threads (close() racing
+    #: __del__ both joined — and a late consumer could then wait on
+    #: data_ready events nobody would ever set again)
+    _GUARDED_BY = {"started": "_close_lock"}
+
     def __init__(self, iters, rename_data=None, rename_label=None):
         super().__init__()
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
+        self._close_lock = threading.Lock()
         self.iters = iters
         self.rename_data = rename_data
         self.rename_label = rename_label
@@ -292,10 +300,13 @@ class PrefetchingIter(DataIter):
 
     def close(self):
         """Idempotent shutdown: signal the prefetch threads and JOIN
-        them (the seed leaked daemon threads that were never joined)."""
-        if not self.started:
-            return
-        self.started = False
+        them (the seed leaked daemon threads that were never joined).
+        Exactly one closer wins the flag flip under the lock; the joins
+        run outside it."""
+        with self._close_lock:
+            if not self.started:
+                return
+            self.started = False
         for e in self.data_taken:
             e.set()
         for t in self.prefetch_threads:

@@ -34,6 +34,13 @@ from .local import KVStoreLocal, _nd_nbytes
 _logger = logging.getLogger("mxnet_tpu.kvstore.dist")
 
 _REDUCE = {"mesh": None, "fn": None}
+_REDUCE_LOCK = threading.Lock()
+
+#: machine-checked lock protocol (mxtpu-lint thread-guard): the cached
+#: world-reduce mesh/fn mutate only under _REDUCE_LOCK — an elastic
+#: reset_world() racing a collective otherwise hands one caller a mesh
+#: from the OLD world and a reduce fn compiled for the new one
+_GUARDED_BY = {"_REDUCE": "_REDUCE_LOCK"}
 
 
 def _barrier_timeout_s() -> float:
@@ -90,21 +97,23 @@ def reset_world():
     world — the elastic-resize hook: a runtime membership change
     re-initializes the kvstore data plane without re-registering the
     store or restarting the process."""
-    _REDUCE["mesh"] = None
-    _REDUCE["fn"] = None
+    with _REDUCE_LOCK:
+        _REDUCE["mesh"] = None
+        _REDUCE["fn"] = None
 
 
 def _reduce_mesh():
     """Global mesh with ONE device per process, ordered by process index."""
-    if _REDUCE["mesh"] is None:
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, d)
-        ordered = [per_proc[i] for i in sorted(per_proc)]
-        from jax.sharding import Mesh
+    with _REDUCE_LOCK:
+        if _REDUCE["mesh"] is None:
+            per_proc = {}
+            for d in jax.devices():
+                per_proc.setdefault(d.process_index, d)
+            ordered = [per_proc[i] for i in sorted(per_proc)]
+            from jax.sharding import Mesh
 
-        _REDUCE["mesh"] = Mesh(_np.array(ordered), ("dp",))
-    return _REDUCE["mesh"]
+            _REDUCE["mesh"] = Mesh(_np.array(ordered), ("dp",))
+        return _REDUCE["mesh"]
 
 
 def _global_allreduce(raw):
@@ -156,12 +165,16 @@ def _global_allreduce_impl(raw):
         NamedSharding(mesh, P("dp")),
         [jax.device_put(raw[None], my_dev)],
     )
-    if _REDUCE["fn"] is None:
-        _REDUCE["fn"] = jax.jit(
-            _accum_sum,
-            out_shardings=NamedSharding(mesh, P()),
-        )
-    out = _REDUCE["fn"](g)
+    with _REDUCE_LOCK:
+        if _REDUCE["fn"] is None:
+            _REDUCE["fn"] = jax.jit(
+                _accum_sum,
+                out_shardings=NamedSharding(mesh, P()),
+            )
+        fn = _REDUCE["fn"]
+    # dispatch OUTSIDE the lock: holding it across a cross-process
+    # collective would serialize every caller behind network latency
+    out = fn(g)
     # the replicated output is locally addressable: take this process's
     # on-device copy directly (no host round-trip) and re-commit it to a
     # single-device array so downstream eager ops stay single-process

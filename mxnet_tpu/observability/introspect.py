@@ -54,6 +54,34 @@ _LOCK = threading.Lock()
 _COSTS: dict = {}  # site -> cost record dict
 _WARNED_DONATION: set = set()
 
+#: mxtpu-graphcheck capture callback (tools/mxtpu_lint/graphcheck/).
+#: When installed, every registration ALSO traces the site's jaxpr and
+#: hands ``(site, jaxpr, compiled, rec, donated, meta)`` to the hook so
+#: the compiled-artifact contract checker sees exactly what each hot
+#: site lowered — no second tracing pipeline, no drift from what runs.
+_GRAPH_HOOK = None
+
+
+def set_graph_hook(cb):
+    """Install (or clear, with ``None``) the graphcheck capture
+    callback; returns the previous hook. The hook must never raise into
+    training — exceptions are swallowed with a warning."""
+    global _GRAPH_HOOK
+    prev, _GRAPH_HOOK = _GRAPH_HOOK, cb
+    return prev
+
+
+def _graph_notify(site, jaxpr, compiled, rec, donated, meta):
+    hook = _GRAPH_HOOK
+    if hook is None:
+        return
+    try:
+        hook(site, jaxpr, compiled, dict(rec) if rec else {},
+             bool(donated), dict(meta) if meta else {})
+    except Exception as e:  # the checker must never take training down
+        _logger.warning("graphcheck hook failed for site %r: %s: %s",
+                        site, type(e).__name__, e)
+
 
 def enabled() -> bool:
     return ENABLED
@@ -228,18 +256,29 @@ def avals_of(args):
         if hasattr(a, "shape") and hasattr(a, "dtype") else a, args)
 
 
-def register_jit(site, jit_fn, args, donated=False, force=False):
+def register_jit(site, jit_fn, args, donated=False, force=False,
+                 graph_meta=None):
     """Register cost/memory analysis for ``jit_fn`` called with
     ``args`` (concrete arrays or the ``avals_of`` skeleton) under site
     name ``site``. One-shot per site unless ``force``; a no-op when
     introspection is disabled. Never raises: an un-lowerable function
-    or an analysis-less backend records a stub with ``error`` set."""
+    or an analysis-less backend records a stub with ``error`` set.
+    ``graph_meta`` annotates the site for mxtpu-graphcheck (e.g. a
+    sanctioned baked-constant exemption) and is only consulted when a
+    graph hook is installed."""
     if not ENABLED:
         return None
     with _LOCK:
         if site in _COSTS and not force:
             return _COSTS[site]
+    jaxpr = None
+    compiled = None
     try:
+        if _GRAPH_HOOK is not None and hasattr(jit_fn, "trace"):
+            try:
+                jaxpr = jit_fn.trace(*args).jaxpr
+            except Exception:
+                jaxpr = None  # un-traceable: the hook still sees memory
         compiled = jit_fn.lower(*args).compile()
         rec = analyze_compiled(site, compiled, donated=donated)
     except Exception as e:  # introspection must never take training down
@@ -247,11 +286,16 @@ def register_jit(site, jit_fn, args, donated=False, force=False):
                "donated": bool(donated),
                "error": f"{type(e).__name__}: {e}"[:200]}
     _publish(rec)
+    _graph_notify(site, jaxpr, compiled, rec, donated, graph_meta)
     return rec
 
 
-def register_compiled(site, compiled, donated=False, force=False):
-    """Register an already-compiled executable (AOT / SPMD paths)."""
+def register_compiled(site, compiled, donated=False, force=False,
+                      jaxpr=None, graph_meta=None):
+    """Register an already-compiled executable (AOT / SPMD paths).
+    Callers that kept the traced ``jaxpr`` may pass it through for
+    mxtpu-graphcheck; without it only the memory-level checks see the
+    site."""
     if not ENABLED:
         return None
     with _LOCK:
@@ -259,6 +303,7 @@ def register_compiled(site, compiled, donated=False, force=False):
             return _COSTS[site]
     rec = analyze_compiled(site, compiled, donated=donated)
     _publish(rec)
+    _graph_notify(site, jaxpr, compiled, rec, donated, graph_meta)
     return rec
 
 

@@ -26,6 +26,11 @@ _logger = logging.getLogger("mxnet_tpu.observability")
 _SERVER = {"httpd": None, "thread": None, "port": None}
 _LOCK = threading.Lock()
 
+#: machine-checked lock protocol (mxtpu-lint thread-guard): the server
+#: singleton mutates only under _LOCK — concurrent serve/stop otherwise
+#: leaks an orphan httpd thread bound to the port
+_GUARDED_BY = {"_SERVER": "_LOCK"}
+
 
 def _make_handler():
     from http.server import BaseHTTPRequestHandler

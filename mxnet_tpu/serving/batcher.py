@@ -94,6 +94,12 @@ class ContinuousBatcher:
     (metrics), after the typed error is set.
     """
 
+    #: machine-checked lock protocol (mxtpu-lint thread-guard):
+    #: lifecycle state flips only under the close lock — submit/close
+    #: racing on `_closed`, or two closers both joining `_thread`, was
+    #: exactly the shutdown flake class PR-8 retired for checkpoints
+    _GUARDED_BY = {"_closed": "_close_lock", "_thread": "_close_lock"}
+
     def __init__(self, dispatch, *, max_batch, max_wait, queue_cap,
                  on_expire=None, autostart=True):
         self._dispatch = dispatch
@@ -108,10 +114,12 @@ class ContinuousBatcher:
             self.start()
 
     def start(self):
-        if self._thread is None and not self._closed:
-            self._thread = threading.Thread(
-                target=self._run, name="mxtpu-serving-batcher", daemon=True)
-            self._thread.start()
+        with self._close_lock:
+            if self._thread is None and not self._closed:
+                self._thread = threading.Thread(
+                    target=self._run, name="mxtpu-serving-batcher",
+                    daemon=True)
+                self._thread.start()
         return self
 
     def qsize(self) -> int:
@@ -239,13 +247,18 @@ class ContinuousBatcher:
         """Idempotent: refuse new submits, drain accepted requests
         (partial batches dispatch), join the scheduler thread."""
         with self._close_lock:
-            if self._closed:
-                if self._thread is not None:
-                    self._thread.join(timeout=10.0)
-                    self._thread = None
-                return
+            first = not self._closed
             self._closed = True
-        thread = self._thread
+            thread = self._thread
+        if not first:
+            # a concurrent/second closer still waits for the drain, but
+            # the JOIN happens outside the lock: holding it across a
+            # 10 s wait would convoy submit()/start() (lock-order rule)
+            if thread is not None:
+                thread.join(timeout=10.0)
+                with self._close_lock:
+                    self._thread = None
+            return
         if thread is None:
             # never started (autostart=False): fail queued requests —
             # nothing will ever dispatch them
@@ -259,7 +272,8 @@ class ContinuousBatcher:
                         "engine closed before its scheduler started"))
         self._queue.put(_CLOSE)
         thread.join(timeout=10.0)
-        self._thread = None
+        with self._close_lock:
+            self._thread = None
 
     def __del__(self):
         try:

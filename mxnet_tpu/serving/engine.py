@@ -230,8 +230,16 @@ class InferenceEngine:
             if _obs.introspect.ENABLED:
                 site = f"serving[{self._name}:{'x'.join(map(str, bucket))}]"
                 if not _obs.introspect.registered(site):
+                    # nets may SANCTION graphcheck rules for their
+                    # lowered form: QuantizedNet bakes its calibrated
+                    # stage payloads as closure consts by design
+                    sanction = getattr(net, "_GRAPHCHECK_CONST_OK", None)
+                    meta = ({"disable": ("baked-constant",),
+                             "reason": str(sanction)}
+                            if sanction else None)
                     _obs.introspect.register_jit(site, jfn,
-                                                 (self._params, x))
+                                                 (self._params, x),
+                                                 graph_meta=meta)
             # warm execution: request 1 must run at steady state
             out = compiled(self._params, x)
             self._single = not isinstance(out, (tuple, list))

@@ -1,0 +1,35 @@
+"""Seeded lock-order violations (tier-1 fixture; never imported).
+
+Expected: an A->B / B->A acquisition cycle, a thread join while
+holding a lock, and a non-reentrant self re-acquisition.
+"""
+
+import threading
+
+_REG_LOCK = threading.Lock()
+_IO_LOCK = threading.Lock()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=lambda: None)
+
+    def swap(self):
+        with _REG_LOCK:
+            with _IO_LOCK:  # edge _REG_LOCK -> _IO_LOCK
+                return 1
+
+    def rotate(self):
+        with _IO_LOCK:
+            with _REG_LOCK:  # edge _IO_LOCK -> _REG_LOCK: closes the cycle
+                return 2
+
+    def close(self):
+        with self._lock:
+            self._thread.join()  # blocks every thread wanting _lock
+
+    def reenter(self):
+        with self._lock:
+            with self._lock:  # plain Lock: self-deadlock
+                return 3

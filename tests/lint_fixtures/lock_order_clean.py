@@ -1,0 +1,44 @@
+"""Clean twin of lock_order_bad.py: one global acquisition order, waits
+happen outside locks (or bounded), RLock re-entry, and a documented
+sanctioned edge."""
+
+import threading
+
+_REG_LOCK = threading.Lock()
+_IO_LOCK = threading.Lock()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._thread = threading.Thread(target=lambda: None)
+
+    def swap(self):
+        with _REG_LOCK:
+            with _IO_LOCK:  # the ONE order: _REG_LOCK before _IO_LOCK, everywhere
+                return 1
+
+    def rotate(self):
+        with _REG_LOCK:
+            with _IO_LOCK:
+                return 2
+
+    def close(self):
+        with self._lock:
+            thread = self._thread
+        thread.join()  # the wait happens OUTSIDE the lock
+
+    def bounded(self):
+        with self._lock:
+            self._thread.join(timeout=1.0)  # bounded wait is fine
+
+    def reenter(self):
+        with self._lock:
+            with self._lock:  # RLock: re-entry is legal
+                return 3
+
+    def sanctioned(self):
+        with _IO_LOCK:
+            # the drain path takes _IO_LOCK alone; documented exception:
+            with _REG_LOCK:  # mxtpu-lint: lock-order-ok
+                return 4

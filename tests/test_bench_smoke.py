@@ -247,3 +247,35 @@ def test_bench_emits_driver_contract(tmp_path):
     assert rep.returncode == 0, rep.stderr
     assert "Executable roofline" in rep.stdout, rep.stdout[-2000:]
     assert "superstep" in rep.stdout
+    # the static graph-contracts section rides along on every report
+    # (pinned sites + rule catalog + baseline size, PR 14)
+    assert "Graph contracts" in rep.stdout, rep.stdout[-2000:]
+    assert "spmd_step" in rep.stdout
+
+
+_HARNESS_RUNNER = """
+import json, sys
+sys.path.insert(0, {root!r})
+from tools.mxtpu_lint.graphcheck.harness import collect_records
+records, sites = collect_records()
+print("SITES=" + json.dumps(sites))
+"""
+
+
+def test_graphcheck_harness_covers_canonical_sites():
+    """The --graph trace harness must register AT LEAST the canonical
+    compiled-site set (trainer_fused, superstep, spmd_step/superstep,
+    kv_bucket, plus one of each prefixed family) — a silently-skipped
+    harness leg would otherwise let the graph gate fake green."""
+    from tools.mxtpu_lint.graphcheck import missing_canonical
+
+    res = subprocess.run(
+        [sys.executable, "-c", _HARNESS_RUNNER.format(root=ROOT)],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-3000:]
+    line = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("SITES=")]
+    assert line, res.stdout[-2000:]
+    sites = json.loads(line[0][len("SITES="):])
+    missing = missing_canonical(sites)
+    assert missing == [], (missing, sites)

@@ -65,7 +65,10 @@ def test_rule_catalog_complete():
     for required in ("host-sync-in-hot-path", "donation-after-use",
                      "capture-unsafe-in-graph", "env-var-discipline",
                      "thread-guard", "telemetry-coverage",
-                     "overlap-window-sync"):
+                     "overlap-window-sync", "lock-order",
+                     # graph leg (PR 14): same registry, graph=True
+                     "donation-dead", "amp-dtype-leak", "baked-constant",
+                     "collective-order", "host-callback-in-graph"):
         assert required in REGISTRY
 
 
@@ -80,6 +83,7 @@ CASES = [
     ("env-var-discipline", "env_bad.py", 3, "env_clean.py"),
     ("thread-guard", "guard_bad.py", 3, "guard_clean.py"),
     ("overlap-window-sync", "overlap_bad.py", 6, "overlap_clean.py"),
+    ("lock-order", "lock_order_bad.py", 3, "lock_order_clean.py"),
 ]
 
 

@@ -14,3 +14,4 @@ from . import rules  # noqa: F401 - registers the rule catalog
 from .engine import (BASELINE_RELPATH, DEFAULT_TARGETS, Finding,  # noqa: F401
                      LintContext, PyFile, REGISTRY, Rule, apply_baseline,
                      load_baseline, register, run, write_baseline)
+from . import graphcheck  # noqa: F401 - registers the graph-leg rules

@@ -1,4 +1,13 @@
-"""CLI: ``python -m tools.mxtpu_lint [--baseline PATH] [--update-baseline]``.
+"""CLI: ``python -m tools.mxtpu_lint [--baseline PATH] [--update-baseline]
+[--graph [--update-contracts]] [--changed [REF]]``.
+
+Two legs share one rule registry, one baseline and one output format:
+the default AST leg parses source; ``--graph`` runs the in-process
+trace harness (imports jax, CPU backend, forced host devices) and
+checks the captured COMPILED artifacts — see
+``tools/mxtpu_lint/graphcheck/``. ``--changed [REF]`` scopes the AST
+leg to ``git diff --name-only REF`` (default HEAD) for fast pre-commit
+runs.
 
 Exit codes: 0 = no new findings (baseline-frozen ones are reported as a
 count only), 1 = new findings, 2 = usage/internal error.
@@ -46,6 +55,20 @@ def main(argv=None):
                     help="print the rule catalog and exit")
     ap.add_argument("--rule", action="append", default=None,
                     help="run only this rule (repeatable)")
+    ap.add_argument("--graph", action="store_true",
+                    help="run the graphcheck leg: trace the canonical "
+                         "compiled sites in-process (imports jax) and "
+                         "check the lowered artifacts")
+    ap.add_argument("--contracts", default=None, metavar="PATH",
+                    help="collective-order contracts JSON (default: "
+                         "tools/graph_contracts.json under the root)")
+    ap.add_argument("--update-contracts", action="store_true",
+                    help="with --graph: re-pin the collective-order "
+                         "signatures instead of checking them")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only .py files changed vs REF "
+                         "(git diff --name-only; default HEAD)")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -66,8 +89,33 @@ def main(argv=None):
                   file=sys.stderr)
             return 2
 
+    if args.update_contracts and not args.graph:
+        print("mxtpu-lint: --update-contracts requires --graph",
+              file=sys.stderr)
+        return 2
+    if args.graph and (args.paths or args.changed or
+                       args.update_baseline):
+        print("mxtpu-lint: --graph traces the whole canonical site set; "
+              "it does not combine with paths, --changed or "
+              "--update-baseline", file=sys.stderr)
+        return 2
+    if args.graph:
+        return _run_graph_leg(args, root)
+    if args.changed is not None and args.paths:
+        print("mxtpu-lint: pass either --changed or explicit paths, "
+              "not both", file=sys.stderr)
+        return 2
+
     files = None
-    if args.paths:
+    if args.changed is not None:
+        files = _changed_files(root, args.changed)
+        if files is None:
+            return 2
+        if not files:
+            print(f"mxtpu-lint OK: no python files changed vs "
+                  f"{args.changed}")
+            return 0
+    elif args.paths:
         files = []
         for p in args.paths:
             p = os.path.abspath(p)
@@ -113,6 +161,80 @@ def main(argv=None):
         ("y" if len(stale) == 1 else "ies") if stale else ""
     print(f"mxtpu-lint OK: 0 new findings ({len(frozen)} baseline-frozen"
           f"{extra}, {n_rules} rules)")
+    return 0
+
+
+def _changed_files(root, ref):
+    """Existing .py files changed vs ``ref`` (absolute paths), None on
+    git failure. Untracked files are not listed — stage them or pass
+    them as explicit paths."""
+    import subprocess
+
+    try:
+        res = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", ref],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        err = (getattr(e, "stderr", "") or str(e)).strip()
+        print(f"mxtpu-lint: git diff vs {ref!r} failed: {err}",
+              file=sys.stderr)
+        return None
+    files = []
+    for line in res.stdout.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            p = os.path.join(root, line)
+            if os.path.isfile(p):  # deletions have nothing to lint
+                files.append(p)
+    return sorted(files)
+
+
+def _run_graph_leg(args, root):
+    """--graph: trace the canonical sites, check the lowered graphs."""
+    from .graphcheck import CONTRACTS_RELPATH, write_contracts
+    from .graphcheck.runner import graph_rule_names, run_graph
+
+    contracts_path = args.contracts or os.path.join(root,
+                                                    CONTRACTS_RELPATH)
+    try:
+        from .graphcheck.harness import collect_records
+
+        records, sites = collect_records()
+    except Exception as e:  # harness drives real framework code
+        print(f"mxtpu-lint: graph harness failed: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    findings, gctx = run_graph(root, records, rules=args.rule,
+                               contracts_path=contracts_path,
+                               update=args.update_contracts)
+    if args.update_contracts:
+        write_contracts(contracts_path, gctx.signatures)
+        print(f"mxtpu-lint: contracts updated: {len(gctx.signatures)} "
+              f"site(s) pinned in "
+              f"{os.path.relpath(contracts_path, root)}")
+        return 0
+
+    baseline_path = args.baseline or os.path.join(root, BASELINE_RELPATH)
+    entries = [] if args.no_baseline else load_baseline(baseline_path)
+    new, frozen, _stale = apply_baseline(findings, entries)
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_dict() for f in new], "frozen": len(frozen),
+            "sites": sites, "rules": graph_rule_names()},
+            indent=1, sort_keys=True))
+        return 1 if new else 0
+    for f in new:
+        print(f"{f.file}: [{f.rule}] {f.message}")
+    if new:
+        print(f"\nmxtpu-lint --graph: {len(new)} NEW finding(s) over "
+              f"{len(sites)} compiled site(s). Fix the graph, annotate "
+              "the registration site (graph_meta disable), or — for a "
+              "deliberate collective reorder — re-pin with "
+              "--update-contracts.", file=sys.stderr)
+        return 1
+    print(f"mxtpu-lint --graph OK: 0 new findings over {len(sites)} "
+          f"compiled site(s) ({len(frozen)} baseline-frozen, "
+          f"{len(graph_rule_names())} rules)")
     return 0
 
 

@@ -47,6 +47,7 @@ _ALIASES = {
     "host-sync-ok": "disable=host-sync-in-hot-path",
     "donation-ok": "disable=donation-after-use",
     "overlap-barrier-ok": "disable=overlap-window-sync",
+    "lock-order-ok": "disable=lock-order",
 }
 
 

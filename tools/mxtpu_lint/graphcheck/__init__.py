@@ -1,0 +1,34 @@
+"""mxtpu-graphcheck: compiled-artifact contract checking (PR 14).
+
+The AST rules (``tools/mxtpu_lint/rules/``) machine-check what the
+SOURCE promises; this package checks what the LOWERED ARTIFACT actually
+does. It hooks the PR-7 ``observability/introspect.py`` registration
+point — every compiled hot site (CachedOp fwd/bwd, ``trainer_fused``,
+``superstep``, ``spmd_step``/``spmd_superstep``, ``kv_bucket``, serving
+AOT buckets) already passes through it — and inspects the captured
+jaxpr + ``memory_analysis`` for the graph-level invariants the tree has
+accumulated: donation actually aliases, AMP graphs don't leak fp32,
+weights are never baked into executables as constants, every rank
+issues the identical collective sequence, and no host callback hides in
+a hot path.
+
+Findings flow through the SAME engine machinery as the AST rules —
+identity ``(graph:<site>, rule, message)``, the shared
+``tools/lint_baseline.json``, ``--json`` output — via
+``python -m tools.mxtpu_lint --graph``, which runs the in-process trace
+harness (:mod:`.harness`) on the CPU backend with forced host devices.
+Collective signatures are pinned in ``tools/graph_contracts.json``
+(:mod:`.contracts`) so an unintended reorder fails tier-1 with a
+readable diff.
+
+Everything here except :mod:`.harness` is pure stdlib and duck-types
+the jaxpr objects, so the rule logic is unit-testable without jax.
+"""
+
+from .contracts import (CONTRACTS_RELPATH, load_contracts,  # noqa: F401
+                        write_contracts)
+from .records import SiteRecord, record_from_capture  # noqa: F401
+from .rules import (CANONICAL_SITES, SPMD_SITES,  # noqa: F401
+                    collective_signature, iter_eqns, missing_canonical)
+from .runner import (DEFAULT_CONST_BYTES, compute_signatures,  # noqa: F401
+                     const_threshold, graph_rule_names, run_graph)

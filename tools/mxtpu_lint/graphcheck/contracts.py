@@ -1,0 +1,49 @@
+"""Pinned collective-order contracts (``tools/graph_contracts.json``).
+
+One checked-in, byte-stable JSON file mapping each SPMD site to its
+canonical collective signature (see
+:func:`..rules.collective_signature`). The ``collective-order`` rule
+diffs every harness run against it, so an unintended reorder — the
+PR-10 overlap machinery's nightmare — fails tier-1 with a readable
+diff instead of deadlocking a real mesh. Regenerate deliberately with
+``python -m tools.mxtpu_lint --graph --update-contracts``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+CONTRACTS_RELPATH = os.path.join("tools", "graph_contracts.json")
+
+
+def load_contracts(path):
+    """The parsed contracts payload, or None when the file is absent
+    or unreadable (the rule then reports unpinned sites)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def write_contracts(path, signatures):
+    """Write ``{site: [sig entries]}`` as sorted, stable JSON (one
+    entry per line via indent, trailing newline) so contract churn is
+    reviewable as a plain diff and repeated regeneration is
+    byte-identical."""
+    payload = {
+        "comment": "pinned per-site collective-order signatures "
+                   "(op/axis/shape/dtype, program order). Checked by "
+                   "`python -m tools.mxtpu_lint --graph`; regenerate "
+                   "deliberately with --update-contracts. See "
+                   "docs/static_analysis.md.",
+        "version": 1,
+        "sites": {site: list(sig)
+                  for site, sig in sorted(signatures.items())},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return payload

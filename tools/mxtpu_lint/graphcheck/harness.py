@@ -1,0 +1,220 @@
+"""In-process trace harness for ``python -m tools.mxtpu_lint --graph``.
+
+Drives a tiny representative workload through every canonical compiled
+site on the CPU backend with 8 forced host devices — the same trick
+``tests/conftest.py`` uses — while a graph hook
+(:func:`mxnet_tpu.observability.introspect.set_graph_hook`) captures a
+:class:`~.records.SiteRecord` for each registration. The legs, in
+order:
+
+1. AMP bf16 trainer (policy ACTIVE at registration, so the
+   amp-dtype-leak rule has something to check): ``trainer_fused`` +
+   ``cachedop_fwd/bwd`` under a bf16 cast policy.
+2. Plain fp32 trainer + one eager op dispatch (``op[...]``).
+3. K-step ``superstep`` (``gluon.Superstep``).
+4. SPMD: ``spmd_step`` TWICE (two independently built
+   :class:`~mxnet_tpu.parallel.spmd.SPMDTrainStep` instances, so the
+   collective-order agreement check compares genuinely separate
+   lowerings) + ``spmd_superstep``.
+5. kvstore ``device`` bucketed pushpull on 2 devices (``kv_bucket``).
+6. Serving AOT buckets (``serving[...]``) + the int8
+   :class:`~mxnet_tpu.contrib.quantization.QuantizedNet` engine, whose
+   stage payloads are the SANCTIONED baked constants.
+
+Everything is fixed-seed and fixed-shape, so site names and collective
+signatures are deterministic run to run. This module imports jax —
+keep it out of ``graphcheck/__init__``; the CLI imports it lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _force_host_devices():
+    """Must run before the first jax import (conftest.py does the same
+    for tier-1); a no-op when jax is already up — then we simply use
+    however many devices the host process has."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def collect_records(steps=2):
+    """Run every leg; returns ``(records, sites)`` where ``records`` is
+    the capture list in registration order and ``sites`` the sorted set
+    of distinct site names seen."""
+    _force_host_devices()
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, fusedstep, gluon, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.data.prefetcher import stack_batches
+    from mxnet_tpu.observability import introspect
+
+    from .records import record_from_capture
+
+    records = []
+
+    def hook(site, jaxpr, compiled, rec, donated, meta):
+        records.append(
+            record_from_capture(site, jaxpr, compiled, rec, donated, meta))
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build_net():
+        mx.random.seed(0)
+        np.random.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(3, in_units=16))
+        net.initialize(init=mx.initializer.Xavier())
+        net.hybridize()
+        return net
+
+    def batch(i, n=16, dtype=None):
+        rs = np.random.RandomState(100 + i)
+        x = rs.randn(n, 8).astype(np.float32)
+        y = rs.randint(0, 3, (n,)).astype(np.float32)
+        if dtype:
+            x = x.astype(dtype)
+        return mx.nd.array(x, dtype=str(x.dtype)), mx.nd.array(y)
+
+    def train_steps(amp_dtype=None):
+        net = build_net()
+        if amp_dtype:
+            amp.convert_model(net)
+        tr = gluon.Trainer(
+            net.collect_params(), "sgd",
+            {"learning_rate": 0.05, "momentum": 0.9,
+             "multi_precision": bool(amp_dtype)}, kvstore=None)
+        for i in range(steps):
+            x, y = batch(i, dtype=amp_dtype)
+            with autograd.record():
+                l = loss_fn(net(x), y)
+            l.backward()
+            tr.step(16)
+
+    def leg_amp():
+        amp.init("bfloat16")
+        try:
+            train_steps(amp_dtype="bfloat16")
+        finally:
+            amp.disable()
+
+    def leg_plain():
+        train_steps()
+        # one eager dispatch so the op[...] site family is represented
+        (mx.nd.ones((4, 4)) + mx.nd.ones((4, 4))).asnumpy()
+
+    def leg_superstep():
+        prev = fusedstep.set_enabled(True)
+        try:
+            net = build_net()
+            tr = gluon.Trainer(
+                net.collect_params(), "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9}, kvstore=None)
+            ss = gluon.Superstep(net, loss_fn, tr, k=2)
+            xs = stack_batches([batch(i)[0] for i in range(2)])
+            ys = stack_batches([batch(i)[1] for i in range(2)])
+            ss.step(xs, ys, 16)
+        finally:
+            fusedstep.set_enabled(prev)
+
+    def leg_spmd():
+        ndev = len(jax.devices())
+        mesh = parallel.make_mesh({"dp": ndev})
+        x, y = batch(0, n=4 * ndev)
+
+        def one(run_super):
+            step = parallel.SPMDTrainStep(
+                build_net(), loss_fn, "sgd", {"momentum": 0.9}, mesh)
+            step(x, y, lr=0.1)
+            if run_super:
+                xs = np.stack([batch(i, n=4 * ndev)[0].asnumpy()
+                               for i in range(2)])
+                ys = np.stack([batch(i, n=4 * ndev)[1].asnumpy()
+                               for i in range(2)])
+                step.run_superstep(xs, ys, lr=0.1)
+
+        one(run_super=True)
+        # second, independently lowered instance: the collective-order
+        # agreement check must see two registrations of spmd_step
+        introspect.reset()
+        one(run_super=False)
+
+    def leg_kvstore():
+        devs = jax.devices()[:2]
+        if len(devs) < 2:
+            return
+        kv = mx.kv.create("device")
+        keys = ["gc_a", "gc_b", "gc_c"]
+        shapes = [(4, 3), (5,), (2, 2)]
+        rng = np.random.RandomState(0)
+        vals, outs = [], []
+        for k, sh in zip(keys, shapes):
+            kv.init(k, mx.nd.zeros(sh))
+            per_dev = []
+            for d in devs:
+                nd = mx.nd.array(rng.rand(*sh).astype(np.float32))
+                nd._set_data(jax.device_put(nd.data, d))
+                per_dev.append(nd)
+            vals.append(per_dev)
+            outs.append(mx.nd.zeros(sh))
+        kv.pushpull(keys, vals, out=outs)
+
+    def leg_serving():
+        from mxnet_tpu.serving import InferenceEngine
+
+        def vec_net():
+            net = nn.HybridSequential()
+            net.add(nn.Dense(4, in_units=8))
+            net.initialize()
+            net[0].weight.set_data(mx.nd.ones((4, 8)) * 0.1)
+            net[0].bias.set_data(mx.nd.zeros((4,)))
+            return net
+
+        eng = InferenceEngine(vec_net(), shapes=[(8,)], max_batch=2,
+                              max_wait_ms=1.0, name="graphcheck")
+        try:
+            eng.predict(np.zeros((8,), np.float32), timeout=30.0)
+        finally:
+            eng.close()
+
+        from mxnet_tpu.contrib.quantization import quantize_net
+
+        calib = [np.random.RandomState(4 + i).rand(4, 8).astype(np.float32)
+                 for i in range(3)]
+        qnet = quantize_net(vec_net(), calib_data=calib)
+        qeng = InferenceEngine(qnet, shapes=[(8,)], max_batch=2,
+                               max_wait_ms=1.0, name="graphcheck-int8")
+        try:
+            qeng.predict(calib[0][0], timeout=30.0)
+        finally:
+            qeng.close()
+
+    prev_hook = introspect.set_graph_hook(hook)
+    prev_enabled = introspect.set_enabled(True)
+    introspect.reset()
+    try:
+        for leg in (leg_amp, leg_plain, leg_superstep, leg_spmd,
+                    leg_kvstore, leg_serving):
+            introspect.reset()
+            leg()
+    finally:
+        introspect.set_graph_hook(prev_hook)
+        introspect.set_enabled(prev_enabled)
+        introspect.reset()
+    return records, sorted({r.site for r in records})

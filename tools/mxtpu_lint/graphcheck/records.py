@@ -1,0 +1,86 @@
+"""Site capture records: the stdlib-visible snapshot of one compiled
+site that the graph rules consume.
+
+The introspect graph hook hands over live jax objects (jaxpr,
+``Compiled``); :func:`record_from_capture` reduces them to a
+:class:`SiteRecord` that keeps only what the rules read — the jaxpr
+itself (duck-typed: rules touch ``.eqns`` / ``.primitive.name`` /
+``.aval`` attributes only), plain const metadata, the alias byte count,
+and the AMP policy active at registration time. Unit tests build
+records from hand-written stub objects; nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+
+class SiteRecord:
+    """One captured compiled site."""
+
+    __slots__ = ("site", "jaxpr", "consts", "alias_bytes", "donated",
+                 "amp_dtype", "meta")
+
+    def __init__(self, site, jaxpr=None, consts=(), alias_bytes=None,
+                 donated=False, amp_dtype=None, meta=None):
+        self.site = str(site)
+        self.jaxpr = jaxpr
+        #: [{"index", "shape", "dtype", "nbytes"}] — literal consts
+        #: closed over by the executable, largest concern first
+        self.consts = list(consts)
+        self.alias_bytes = alias_bytes
+        self.donated = bool(donated)
+        #: "bfloat16"/"float16" when a cast policy was ACTIVE when this
+        #: site registered; None otherwise (amp rules stay quiet then)
+        self.amp_dtype = amp_dtype
+        self.meta = dict(meta or {})
+
+    def disabled_rules(self):
+        """Graph rules sanctioned off for this site at the registration
+        call site (``graph_meta={"disable": ("baked-constant",)}``)."""
+        d = self.meta.get("disable", ())
+        if isinstance(d, str):
+            d = (d,)
+        return set(d)
+
+    def __repr__(self):
+        return (f"SiteRecord({self.site!r}, consts={len(self.consts)}, "
+                f"alias={self.alias_bytes}, donated={self.donated}, "
+                f"amp={self.amp_dtype})")
+
+
+def _const_nbytes(c):
+    n = getattr(c, "nbytes", None)
+    if n is not None:
+        return int(n)
+    size = getattr(c, "size", None)
+    item = getattr(getattr(c, "dtype", None), "itemsize", None)
+    if size is not None and item is not None:
+        return int(size) * int(item)
+    return 0
+
+
+def record_from_capture(site, jaxpr, compiled, rec, donated, meta):
+    """Build a :class:`SiteRecord` from one introspect graph-hook
+    callback. ``rec`` is the introspect cost record (carries
+    ``alias_bytes`` from ``memory_analysis``); the AMP policy is read
+    from the live ``amp.policy`` state so the record reflects what was
+    active when the site lowered."""
+    consts = []
+    for i, c in enumerate(getattr(jaxpr, "consts", ()) or ()):
+        consts.append({
+            "index": i,
+            "shape": tuple(int(d) for d in getattr(c, "shape", ())),
+            "dtype": str(getattr(c, "dtype", "?")),
+            "nbytes": _const_nbytes(c),
+        })
+    consts.sort(key=lambda d: (-d["nbytes"], d["index"]))
+    amp = None
+    try:
+        from mxnet_tpu.amp import policy as _policy
+
+        amp = _policy.target_dtype()
+    except Exception:
+        amp = None
+    return SiteRecord(
+        site, jaxpr=jaxpr, consts=consts,
+        alias_bytes=(rec or {}).get("alias_bytes"),
+        donated=donated, amp_dtype=str(amp) if amp else None, meta=meta)

@@ -1,0 +1,339 @@
+"""The five graph rules. Pure stdlib — jaxprs are duck-typed (the walk
+touches ``.eqns`` / ``.primitive.name`` / ``.params`` / ``.aval`` only)
+so every rule is unit-testable on hand-built stubs without jax.
+
+Each rule retires a historical bug class (docs/static_analysis.md):
+
+- ``donation-dead``      — the PR-7 once-per-site donation warning,
+  upgraded to a findable, baselineable check.
+- ``amp-dtype-leak``     — the PR-5 fp16 underflow family: ops escaping
+  the cast policy in either direction.
+- ``baked-constant``     — a closure-captured weight lowered as an
+  executable literal = silent recompile-per-update + HBM bloat.
+- ``collective-order``   — the PR-10 overlap machinery's nightmare: a
+  reordered/reshaped collective sequence deadlocks real multi-rank
+  meshes. Signatures are pinned in ``tools/graph_contracts.json``.
+- ``host-callback-in-graph`` — a ``pure_callback``/``io_callback`` in a
+  hot site round-trips to Python on every dispatch.
+
+Graph findings use ``file = "graph:<site>"`` so the shared baseline /
+suppression identity ``(file, rule, message)`` applies unchanged.
+"""
+
+from __future__ import annotations
+
+from ..engine import Finding, Rule, register
+
+#: the exact site names the trace harness must register (plus one of
+#: each prefixed family) — the tier-1 smoke asserts against this so a
+#: silently-skipped harness leg cannot fake green
+CANONICAL_SITES = ("trainer_fused", "superstep", "spmd_step",
+                   "spmd_superstep", "kv_bucket")
+CANONICAL_PREFIXES = ("cachedop_fwd[", "cachedop_bwd[", "serving[", "op[")
+
+#: sites whose collective signature is ALWAYS pinned in
+#: graph_contracts.json, even when (today) it is empty — adding a
+#: collective to one of these is a contract change, not a drive-by
+SPMD_SITES = ("spmd_step", "spmd_superstep", "kv_bucket",
+              "kv_bucket_pack")
+
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum2", "psum_scatter", "reduce_scatter", "all_gather",
+    "all_to_all", "all_to_all_p",
+})
+
+_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call",
+})
+
+#: primitives that MUST run in low precision under an active cast
+#: policy (an all-f32 matmul under amp = the policy silently fell off)
+_MATMUL_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+#: transcendentals the FP32_OPS policy exists to protect (softmax /
+#: log_softmax / norm internals) — computing these in bf16/fp16 is the
+#: PR-5 underflow class
+_FP32_ONLY_PRIMS = frozenset({
+    "exp", "log", "log1p", "erf", "lgamma", "digamma",
+})
+
+_LOW_DTYPES = ("bfloat16", "float16")
+
+_FLOAT_DTYPES = ("bfloat16", "float16", "float32", "float64")
+
+
+def missing_canonical(sites):
+    """Canonical coverage check for a harness run: returns the sorted
+    list of canonical sites/families NOT present in ``sites``."""
+    sites = set(sites)
+    missing = [s for s in CANONICAL_SITES if s not in sites]
+    for pre in CANONICAL_PREFIXES:
+        if not any(s.startswith(pre) for s in sites):
+            missing.append(pre + "...]")
+    return sorted(missing)
+
+
+# ---------------------------------------------------------------------------
+# duck-typed jaxpr walking
+# ---------------------------------------------------------------------------
+
+def _prim_name(eqn):
+    p = getattr(eqn, "primitive", None)
+    return getattr(p, "name", str(p))
+
+
+def iter_eqns(obj):
+    """Pre-order walk over every eqn of a (Closed)Jaxpr, descending
+    into sub-jaxprs held in eqn params (shard_map / scan / cond / jit
+    bodies) so collectives inside a ``shard_map`` body appear in
+    program order. Handles ``Jaxpr`` (has ``.eqns``), ``ClosedJaxpr``
+    (``.jaxpr.eqns``) and lists/tuples of either."""
+    eqns = getattr(obj, "eqns", None)
+    if eqns is None:
+        inner = getattr(obj, "jaxpr", None)
+        eqns = getattr(inner, "eqns", None) if inner is not None else None
+    for eqn in eqns or ():
+        yield eqn
+        params = getattr(eqn, "params", None) or {}
+        for v in params.values():
+            cands = v if isinstance(v, (list, tuple)) else (v,)
+            for cand in cands:
+                if hasattr(cand, "eqns") or hasattr(cand, "jaxpr"):
+                    for sub in iter_eqns(cand):
+                        yield sub
+
+
+def _aval_sig(var):
+    aval = getattr(var, "aval", None)
+    shape = "x".join(str(d) for d in getattr(aval, "shape", ())) or "()"
+    return f"{getattr(aval, 'dtype', '?')}[{shape}]"
+
+
+def collective_signature(jaxpr):
+    """The canonical ordered collective sequence of one jaxpr:
+    ``"<prim>[<axes>] <dtype>[<shape>], ..."`` per eqn, in program
+    order — op, axis and bucket shape/dtype, exactly what every rank
+    must agree on (SURVEY §2.5's sync contract)."""
+    sig = []
+    for eqn in iter_eqns(jaxpr):
+        name = _prim_name(eqn)
+        if name not in _COLLECTIVE_PRIMS:
+            continue
+        params = getattr(eqn, "params", None) or {}
+        axes = params.get("axes", params.get("axis_name"))
+        if isinstance(axes, (list, tuple)):
+            axes = ",".join(str(a) for a in axes)
+        ins = " ".join(_aval_sig(v) for v in getattr(eqn, "invars", ())
+                       or ()) or "?"
+        sig.append(f"{name}[{axes}] {ins}")
+    return sig
+
+
+def _dtype_str(var):
+    return str(getattr(getattr(var, "aval", None), "dtype", ""))
+
+
+# ---------------------------------------------------------------------------
+# rule base + the five rules
+# ---------------------------------------------------------------------------
+
+class GraphRule(Rule):
+    """A rule over captured :class:`~.records.SiteRecord` objects
+    rather than parsed files. Registered in the SAME registry as the
+    AST rules (``--rule`` / ``--list-rules`` see one catalog); the AST
+    runner calls the inherited no-op ``check_file``."""
+
+    graph = True
+
+    def check_site(self, rec, gctx):
+        return []
+
+    def finalize_graph(self, gctx):
+        return []
+
+    def _finding(self, site, message):
+        return Finding(self.name, f"graph:{site}", 0, message)
+
+
+@register
+class DonationDeadRule(GraphRule):
+    name = "donation-dead"
+    doc = ("a site built with donated args whose compiled executable "
+           "aliased 0 bytes — the donation silently failed and peak "
+           "memory holds both copies")
+
+    def check_site(self, rec, gctx):
+        if not rec.donated or rec.alias_bytes is None:
+            return []  # not donated / backend without memory analysis
+        if rec.alias_bytes > 0:
+            return []
+        return [self._finding(
+            rec.site,
+            "arguments are donated but the compiled executable aliases "
+            "0 bytes — donation is dead (peak memory holds input AND "
+            "output copies); drop the donate_argnums or fix the "
+            "sharding/dtype mismatch blocking the alias")]
+
+
+@register
+class AmpDtypeLeakRule(GraphRule):
+    name = "amp-dtype-leak"
+    doc = ("under an active bf16/fp16 cast policy: matmuls computing "
+           "entirely in f32 (policy fell off) or FP32-enforced "
+           "transcendentals computing in low precision (underflow)")
+
+    def check_site(self, rec, gctx):
+        if rec.amp_dtype not in _LOW_DTYPES or rec.jaxpr is None:
+            return []
+        out = []
+        seen = set()
+        for eqn in iter_eqns(rec.jaxpr):
+            name = _prim_name(eqn)
+            if name in _MATMUL_PRIMS:
+                outs = getattr(eqn, "outvars", ()) or ()
+                ins = getattr(eqn, "invars", ()) or ()
+                in_f = [_dtype_str(v) for v in ins
+                        if _dtype_str(v) in _FLOAT_DTYPES]
+                if (outs and _dtype_str(outs[0]) == "float32" and in_f
+                        and all(d == "float32" for d in in_f)):
+                    msg = (f"`{name}` ({_aval_sig(outs[0])}) computes "
+                           f"entirely in float32 under the "
+                           f"{rec.amp_dtype} cast policy — the matmul "
+                           "escaped low precision (recheck the cast "
+                           "boundary / net.cast)")
+                    if msg not in seen:
+                        seen.add(msg)
+                        out.append(self._finding(rec.site, msg))
+            elif name in _FP32_ONLY_PRIMS:
+                outs = getattr(eqn, "outvars", ()) or ()
+                if outs and _dtype_str(outs[0]) in _LOW_DTYPES:
+                    msg = (f"fp32-enforced op `{name}` computes in "
+                           f"{_aval_sig(outs[0])} under the "
+                           f"{rec.amp_dtype} cast policy — FP32_OPS "
+                           "contract violated (amp/policy.py), the "
+                           "PR-5 underflow class")
+                    if msg not in seen:
+                        seen.add(msg)
+                        out.append(self._finding(rec.site, msg))
+        return out
+
+
+@register
+class BakedConstantRule(GraphRule):
+    name = "baked-constant"
+    doc = ("a literal constant above MXTPU_GRAPHCHECK_CONST_BYTES "
+           "(default 1 MiB) baked into an executable — a closure-"
+           "captured weight means recompile-per-update + HBM bloat")
+
+    def check_site(self, rec, gctx):
+        thr = gctx.const_bytes
+        out = []
+        for c in rec.consts:
+            if c["nbytes"] <= thr:
+                continue
+            shape = "x".join(str(d) for d in c["shape"]) or "()"
+            out.append(self._finding(
+                rec.site,
+                f"executable bakes a {c['dtype']}[{shape}] constant "
+                f"({c['nbytes']} bytes > {thr} threshold) — pass it as "
+                "an argument instead of closing over it, or sanction "
+                "it at the registration site with "
+                "graph_meta={'disable': ('baked-constant',)}"))
+        return out
+
+
+@register
+class HostCallbackRule(GraphRule):
+    name = "host-callback-in-graph"
+    doc = ("a pure_callback/io_callback/debug_callback eqn inside a "
+           "hot-site jaxpr — every dispatch round-trips to Python")
+
+    def check_site(self, rec, gctx):
+        if rec.jaxpr is None:
+            return []
+        out = []
+        seen = set()
+        for eqn in iter_eqns(rec.jaxpr):
+            name = _prim_name(eqn)
+            if name in _CALLBACK_PRIMS and name not in seen:
+                seen.add(name)
+                out.append(self._finding(
+                    rec.site,
+                    f"host callback `{name}` inside the compiled graph "
+                    "— the executable re-enters Python on every "
+                    "dispatch, serializing the device stream"))
+        return out
+
+
+@register
+class CollectiveOrderRule(GraphRule):
+    name = "collective-order"
+    doc = ("SPMD sites must issue the exact collective sequence pinned "
+           "in tools/graph_contracts.json, and every registration of a "
+           "site must agree — a reorder deadlocks real meshes")
+
+    def finalize_graph(self, gctx):
+        findings = []
+        sigs = {}
+        for rec in gctx.records:
+            if rec.jaxpr is None:
+                continue
+            sigs.setdefault(rec.site, []).append(
+                collective_signature(rec.jaxpr))
+        tracked = {}
+        for site in sorted(sigs):
+            first = sigs[site][0]
+            for other in sigs[site][1:]:
+                if other != first:
+                    findings.append(self._finding(
+                        site,
+                        "registrations of this site disagree on the "
+                        f"collective sequence: {first} vs {other} — "
+                        "nondeterministic trace = ranks will not agree"))
+                    break
+            if site in SPMD_SITES or first:
+                tracked[site] = first
+        gctx.signatures = tracked
+        if gctx.contracts is None or gctx.update:
+            return findings
+        pinned_sites = gctx.contracts.get("sites", {})
+        for site in sorted(tracked):
+            pinned = pinned_sites.get(site)
+            if pinned is None:
+                findings.append(self._finding(
+                    site,
+                    "collective signature is not pinned in "
+                    "tools/graph_contracts.json — review it and run "
+                    "`python -m tools.mxtpu_lint --graph "
+                    "--update-contracts`"))
+            elif list(pinned) != tracked[site]:
+                findings.append(self._finding(
+                    site, _contract_diff(site, pinned, tracked[site])))
+        if gctx.records:
+            for site in sorted(pinned_sites):
+                if site not in tracked:
+                    findings.append(self._finding(
+                        site,
+                        "pinned in tools/graph_contracts.json but not "
+                        "registered by the trace harness — stale "
+                        "contract, or a silently-skipped harness leg"))
+        return findings
+
+
+def _contract_diff(site, pinned, got):
+    """A readable first-divergence diff for a contract mismatch."""
+    pinned, got = list(pinned), list(got)
+    n = max(len(pinned), len(got))
+    for i in range(n):
+        a = pinned[i] if i < len(pinned) else "<end>"
+        b = got[i] if i < len(got) else "<end>"
+        if a != b:
+            return (f"collective sequence diverges from the pinned "
+                    f"contract at position {i}: pinned `{a}`, traced "
+                    f"`{b}` ({len(pinned)} pinned vs {len(got)} traced "
+                    "collectives) — if intentional, review and run "
+                    "`python -m tools.mxtpu_lint --graph "
+                    "--update-contracts`")
+    return (f"collective sequence changed vs the pinned contract "
+            f"({len(pinned)} pinned vs {len(got)} traced)")

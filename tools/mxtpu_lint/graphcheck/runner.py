@@ -1,0 +1,103 @@
+"""Graph-leg runner: applies the registered graph rules to a list of
+captured :class:`~.records.SiteRecord` objects and returns engine
+:class:`~..engine.Finding` objects — identity ``(graph:<site>, rule,
+message)`` — ready for the shared baseline machinery.
+
+Stdlib-only; the jax-importing trace harness lives in :mod:`.harness`
+and is only pulled in by ``python -m tools.mxtpu_lint --graph``.
+"""
+
+from __future__ import annotations
+
+from ..engine import REGISTRY
+from .contracts import load_contracts
+from .rules import collective_signature
+
+#: baked-constant threshold default: 1 MiB of literal payload
+DEFAULT_CONST_BYTES = 1 << 20
+
+
+def const_threshold():
+    """MXTPU_GRAPHCHECK_CONST_BYTES via the blessed accessor (the env
+    rule's contract — docs/env_vars.md); the default when mxnet_tpu is
+    not importable (pure-stdlib unit runs)."""
+    try:
+        from mxnet_tpu.base import getenv
+
+        return int(getenv("MXTPU_GRAPHCHECK_CONST_BYTES",
+                          DEFAULT_CONST_BYTES, dtype=int))
+    except Exception:
+        return DEFAULT_CONST_BYTES
+
+
+def graph_rule_names():
+    return sorted(n for n, cls in REGISTRY.items()
+                  if getattr(cls, "graph", False))
+
+
+class GraphContext:
+    """Shared state for one graph run (the rules' ``gctx``)."""
+
+    def __init__(self, records, contracts=None, const_bytes=None,
+                 update=False):
+        self.records = list(records)
+        self.contracts = contracts
+        self.const_bytes = (const_bytes if const_bytes is not None
+                            else const_threshold())
+        self.update = bool(update)
+        #: filled by the collective-order rule (or compute_signatures):
+        #: {site: [sig entries]} for every tracked site
+        self.signatures = {}
+
+
+def compute_signatures(records):
+    """{site: collective signature} for every tracked site — the
+    payload ``--update-contracts`` pins, independent of any ``--rule``
+    filter (first registration wins, matching the rule's check)."""
+    from .rules import SPMD_SITES
+
+    out = {}
+    for rec in records:
+        if rec.jaxpr is None or rec.site in out:
+            continue
+        sig = collective_signature(rec.jaxpr)
+        if rec.site in SPMD_SITES or sig:
+            out[rec.site] = sig
+    return out
+
+
+def _site_of(finding):
+    return finding.file[len("graph:"):] if \
+        finding.file.startswith("graph:") else finding.file
+
+
+def run_graph(root, records, rules=None, contracts_path=None,
+              update=False, const_bytes=None):
+    """Run the graph rules over ``records``. Returns ``(findings,
+    gctx)`` with per-site registration-meta suppressions applied
+    (baseline subtraction is the caller's concern, exactly like
+    :func:`..engine.run`). ``rules`` is an iterable of rule NAMES —
+    non-graph names are ignored here, so one ``--rule`` list can span
+    both legs."""
+    contracts = load_contracts(contracts_path) if contracts_path else None
+    gctx = GraphContext(records, contracts=contracts,
+                        const_bytes=const_bytes, update=update)
+    wanted = set(rules) if rules else None
+    active = [REGISTRY[n]() for n in graph_rule_names()
+              if wanted is None or n in wanted]
+    findings = []
+    for rule in active:
+        for rec in records:
+            findings.extend(rule.check_site(rec, gctx))
+        findings.extend(rule.finalize_graph(gctx))
+    if not gctx.signatures:
+        gctx.signatures = compute_signatures(records)
+    disabled = {}
+    for rec in records:
+        d = rec.disabled_rules()
+        if d:
+            disabled.setdefault(rec.site, set()).update(d)
+    findings = [f for f in findings
+                if f.rule not in disabled.get(_site_of(f), ())]
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    return findings, gctx

@@ -2,5 +2,6 @@
 rule with the engine registry (see docs/static_analysis.md for the
 catalog with rationale)."""
 
-from . import (capture, donation, env_vars, host_sync, overlap,
-               telemetry, thread_guard)  # noqa: F401 - import-for-registration
+from . import (capture, donation, env_vars, host_sync, lock_order,
+               overlap, telemetry,
+               thread_guard)  # noqa: F401 - import-for-registration

@@ -1,0 +1,403 @@
+"""Rule: lock-order.
+
+Bug class retired: ABBA deadlock and convoying between the tree's
+background threads (serving batcher, checkpoint writer, elastic
+coordinator, telemetry server). The thread-guard rule checks that
+guarded STATE is touched under its lock; this rule checks the locks
+THEMSELVES — every ``with <lock>:`` nesting contributes an edge to one
+global acquisition-order graph, and:
+
+* a cycle in that graph (``A`` held while taking ``B`` in one function,
+  ``B`` held while taking ``A`` in another — possibly in different
+  modules) is a deadlock waiting for the right thread interleaving;
+* a blocking call issued WHILE HOLDING a lock (zero-arg ``join()`` /
+  ``future.result()`` / ``Queue.get()``, a ``put()`` into a bounded
+  queue, socket I/O) convoys every other thread that needs the lock —
+  and deadlocks outright when the waited-on thread needs it too.
+
+Lock identity is scoped: ``self._lock`` in class ``C`` of ``a/b.py`` is
+``a/b.py::C._lock`` (instances share ordering discipline), a module
+global ``_LOCK`` is ``a/b.py::_LOCK``. Edges propagate one call level:
+``self.m()`` / ``f()`` under a held lock contributes edges to every
+lock the (same-class / same-file) callee transitively acquires.
+Re-acquiring the SAME lock is flagged only when it is provably a plain
+``threading.Lock`` (non-reentrant) — ``RLock``/``Condition`` re-entry
+is legal.
+
+A deliberate, documented exception is annotated at the acquisition or
+call line::
+
+    with self._swap_lock:      # mxtpu-lint: lock-order-ok
+        self._drain.join()     # mxtpu-lint: lock-order-ok  (bounded:
+            ...                #   drain thread never takes swap_lock)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, Rule, dotted_name, register
+
+#: with-item / receiver name shapes that read as a lock
+_CV_NAMES = {"_cv", "cv", "_cond", "cond"}
+
+#: threading constructors worth classifying (last dotted component)
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+
+#: methods that block the calling thread outright on a socket
+_SOCKET_BLOCKING = {"accept", "recv", "recvfrom", "connect", "sendall"}
+
+#: receiver-name fragments that suggest a (possibly bounded) queue
+_QUEUEISH = ("queue", "_q", "inbox", "jobs", "work", "pending")
+
+
+def _is_lock_name(dotted):
+    last = dotted.rsplit(".", 1)[-1].lower()
+    return "lock" in last or last in _CV_NAMES or last.endswith("_mutex")
+
+
+def _lock_id(relpath, cls, selfname, dotted):
+    """Scoped lock identity (see module docstring)."""
+    parts = dotted.split(".")
+    if selfname and parts[0] == selfname and len(parts) >= 2:
+        return f"{relpath}::{cls or '<obj>'}." + ".".join(parts[1:])
+    return f"{relpath}::{dotted}"
+
+
+def _queueish(recv):
+    last = recv.rsplit(".", 1)[-1].lower()
+    return any(fragment in last for fragment in _QUEUEISH) or last == "q"
+
+
+def _blocking_reason(call):
+    """Why this Call blocks the holder, or None."""
+    name = dotted_name(call.func)
+    if not name or "." not in name:
+        return None
+    recv, meth = name.rsplit(".", 1)
+    kw = {k.arg for k in call.keywords}
+    if meth == "join" and not call.args and "timeout" not in kw:
+        return f"`{name}()` joins a thread with no timeout"
+    if meth == "result" and not call.args and "timeout" not in kw:
+        return f"`{name}()` waits on a future with no timeout"
+    if meth == "get" and not call.args and not ({"timeout", "block"} & kw):
+        return f"`{name}()` blocks on an empty queue"
+    if meth == "put" and len(call.args) == 1 and \
+            not ({"timeout", "block"} & kw) and _queueish(recv):
+        return f"`{name}(...)` blocks when the queue is bounded and full"
+    if meth in _SOCKET_BLOCKING:
+        return f"`{name}(...)` is blocking socket I/O"
+    return None
+
+
+def _stmt_children(s):
+    """Nested statements of a statement (If/For/Try bodies...)."""
+    for _field, value in ast.iter_fields(s):
+        if isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.stmt):
+                    yield v
+                elif isinstance(v, ast.ExceptHandler):
+                    yield from v.body
+
+
+def _calls_shallow(s):
+    """Calls evaluated BY this statement itself: its expression parts,
+    not its nested statement bodies, not deferred lambda/def bodies."""
+    stack = list(ast.iter_child_nodes(s))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.stmt, ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@register
+class LockOrderRule(Rule):
+    name = "lock-order"
+    doc = ("lock-acquisition-order cycles (deadlock) and blocking "
+           "calls made while holding a lock")
+
+    # ---- per-file scan ----------------------------------------------
+
+    def check_file(self, pf, ctx):
+        st = ctx.scratch.setdefault(self.name, {
+            "funcs": {},    # (relpath, funckey) -> facts
+            "ctors": {},    # lock id -> constructor ("Lock", "RLock"...)
+            "edges": [],    # (held, acquired, relpath, line)
+            "selfs": [],    # (lock id, relpath, line) re-acquisitions
+        })
+        findings = []
+
+        def scan_func(fn, funckey, cls, selfname):
+            facts = {"acquires": [], "calls": []}
+            st["funcs"][(pf.relpath, funckey)] = facts
+
+            def lid(dotted):
+                return _lock_id(pf.relpath, cls, selfname, dotted)
+
+            def edge_ok(line):
+                return not pf.suppressed(
+                    Finding(self.name, pf.relpath, line, ""))
+
+            def record_acquire(dotted, line, held):
+                acquired = lid(dotted)
+                facts["acquires"].append((acquired, line))
+                for h in held:
+                    if h == acquired:
+                        if edge_ok(line):
+                            st["selfs"].append((acquired, pf.relpath,
+                                                line))
+                    elif edge_ok(line):
+                        st["edges"].append((h, acquired, pf.relpath,
+                                            line))
+
+            def callee_key(call):
+                name = dotted_name(call.func)
+                if not name:
+                    return None
+                parts = name.split(".")
+                if selfname and parts[0] == selfname and \
+                        len(parts) == 2 and cls:
+                    return f"{cls}.{parts[1]}"
+                if len(parts) == 1:
+                    return parts[0]
+                return None
+
+            def handle_calls(stmt, held):
+                for call in _calls_shallow(stmt):
+                    name = dotted_name(call.func)
+                    if held and name and "." in name:
+                        recv, meth = name.rsplit(".", 1)
+                        if meth == "acquire" and _is_lock_name(recv):
+                            record_acquire(recv, call.lineno, held)
+                    if held and not pf.suppressed(Finding(
+                            self.name, pf.relpath, call.lineno, "")):
+                        why = _blocking_reason(call)
+                        if why:
+                            findings.append(Finding(
+                                self.name, pf.relpath, call.lineno,
+                                f"{why} while holding "
+                                f"`{', '.join(sorted(set(held)))}` — "
+                                "every thread needing the lock convoys "
+                                "behind this wait (deadlock if the "
+                                "waited-on side wants it); move the "
+                                "wait outside the lock or bound it, or "
+                                "annotate `# mxtpu-lint: "
+                                "lock-order-ok`"))
+                    ck = callee_key(call)
+                    if ck:
+                        facts["calls"].append(
+                            (ck, call.lineno, tuple(held)))
+
+            def walk(stmts, held):
+                for s in stmts:
+                    if isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                        # a closure/inner def runs later: locks held
+                        # HERE are not held THEN (scanned separately,
+                        # empty stack)
+                        continue
+                    if isinstance(s, (ast.With, ast.AsyncWith)):
+                        inner = list(held)
+                        for item in s.items:
+                            d = dotted_name(item.context_expr)
+                            if d and _is_lock_name(d):
+                                record_acquire(d, s.lineno, inner)
+                                inner.append(lid(d))
+                        handle_calls(s, held)
+                        walk(s.body, inner)
+                        continue
+                    handle_calls(s, held)
+                    walk(list(_stmt_children(s)), held)
+
+            walk(fn.body, [])
+
+        def scan_body(body, cls, prefix):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    selfname = None
+                    if cls and node.args.args:
+                        selfname = node.args.args[0].arg
+                    scan_func(node, f"{prefix}{node.name}", cls,
+                              selfname)
+                    # nested defs get their own (call-unresolvable)
+                    # entries so their internal edges still count
+                    scan_body(node.body, cls,
+                              f"{prefix}{node.name}.")
+                elif isinstance(node, ast.ClassDef) and cls is None:
+                    scan_body(node.body, node.name, f"{node.name}.")
+
+        scan_body(pf.tree.body, None, "")
+        self._scan_ctors(pf, st["ctors"])
+        return findings
+
+    def _scan_ctors(self, pf, ctors):
+        """Classify locks by constructor: ``X = threading.Lock()``,
+        ``self.X = Lock()``, class-body assigns. Condition() wraps an
+        RLock by default — reentrant."""
+
+        def classify(target_dotted, value, cls, selfname):
+            if not isinstance(value, ast.Call):
+                return
+            ctor = dotted_name(value.func)
+            ctor = ctor.rsplit(".", 1)[-1] if ctor else None
+            if ctor not in _LOCK_CTORS:
+                return
+            key = _lock_id(pf.relpath, cls, selfname, target_dotted)
+            ctors.setdefault(key, ctor)
+
+        def visit(body, cls, selfname):
+            for node in body:
+                if isinstance(node, ast.Assign) and \
+                        len(node.targets) == 1:
+                    d = dotted_name(node.targets[0])
+                    if d:
+                        classify(d, node.value, cls, selfname)
+                elif isinstance(node, ast.ClassDef) and cls is None:
+                    visit(node.body, node.name, None)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    sn = selfname
+                    if cls and node.args.args:
+                        sn = node.args.args[0].arg
+                    visit(node.body, cls, sn)
+                elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                    visit(node.body, cls, selfname)
+
+        visit(pf.tree.body, None, None)
+
+    # ---- global graph -----------------------------------------------
+
+    def finalize(self, ctx):
+        st = ctx.scratch.get(self.name)
+        if not st:
+            return []
+        funcs, edges = st["funcs"], list(st["edges"])
+
+        # one call level deep: a lock held across self.m()/f() orders
+        # before everything the callee (transitively) acquires
+        memo = {}
+
+        def trans_acquires(key, trail):
+            if key in memo:
+                return memo[key]
+            if key in trail:
+                return {}
+            facts = funcs.get(key)
+            if facts is None:
+                return {}
+            out = {}
+            for lock, line in facts["acquires"]:
+                out.setdefault(lock, (key[0], line))
+            for ck, line, _held in facts["calls"]:
+                for lock, site in \
+                        trans_acquires((key[0], ck), trail | {key}).items():
+                    out.setdefault(lock, site)
+            memo[key] = out
+            return out
+
+        for key, facts in sorted(funcs.items()):
+            for ck, line, held in facts["calls"]:
+                if not held:
+                    continue
+                for lock, site in \
+                        trans_acquires((key[0], ck), {key}).items():
+                    for h in held:
+                        if h != lock:
+                            edges.append((h, lock, site[0], site[1]))
+
+        graph, sites = {}, {}
+        for a, b, relpath, line in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+            sites.setdefault((a, b), (relpath, line))
+
+        findings = [
+            Finding(self.name, relpath, line,
+                    f"non-reentrant `{lock}` re-acquired while already "
+                    "held — threading.Lock self-deadlocks; use RLock "
+                    "or drop the inner acquisition")
+            for lock, relpath, line in sorted(set(st["selfs"]))
+            if st["ctors"].get(lock) == "Lock"
+        ]
+
+        for cycle in _cycles(graph):
+            hops = []
+            for i, a in enumerate(cycle):
+                b = cycle[(i + 1) % len(cycle)]
+                relpath, line = sites[(a, b)]
+                hops.append(f"`{a}` -> `{b}` ({relpath}:{line})")
+            relpath, line = sites[(cycle[0], cycle[1 % len(cycle)])]
+            findings.append(Finding(
+                self.name, relpath, line,
+                "lock acquisition-order cycle: " + "; ".join(hops) +
+                " — threads taking these in opposite orders deadlock; "
+                "pick ONE global order (docs/static_analysis.md) or "
+                "annotate the sanctioned edge with `# mxtpu-lint: "
+                "lock-order-ok`"))
+        return findings
+
+
+def _cycles(graph):
+    """One representative simple cycle per strongly-connected component
+    of size > 1, rotated to start at its smallest node (deterministic).
+    Tarjan over the (tiny) lock graph."""
+    index, low, on, stack, sccs = {}, {}, set(), [], []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in sorted(graph.get(v, ())):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    out = []
+    for comp in sorted(sccs):
+        members = set(comp)
+        start = comp[0]
+        # DFS for a simple cycle start -> ... -> start inside the SCC
+        path, seen = [start], {start}
+
+        def dfs(v):
+            for w in sorted(graph.get(v, ())):
+                if w not in members:
+                    continue
+                if w == start and len(path) > 1:
+                    return True
+                if w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    if dfs(w):
+                        return True
+                    path.pop()
+            return False
+
+        if dfs(start):
+            out.append(list(path))
+    return out

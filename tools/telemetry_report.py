@@ -295,6 +295,53 @@ def render_steps(events):
     return "\n".join(lines)
 
 
+def render_graph_contracts(root=None):
+    """Static 'Graph contracts' section: what `mxtpu-lint --graph` is
+    holding the tree to — pinned collective-order sites, the graph rule
+    catalog, and the shared baseline size. Read from the checked-in
+    tools/graph_contracts.json + tools/lint_baseline.json next to this
+    script; anything missing or malformed renders as absent/'-', never
+    a crash (the report must run on trimmed CI artifact dirs)."""
+    import os
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        with open(os.path.join(root, "tools", "graph_contracts.json"),
+                  encoding="utf-8") as f:
+            sites = json.load(f).get("sites", {})
+        assert isinstance(sites, dict)
+    except Exception:
+        return ""
+    n_coll = sum(len(v) for v in sites.values()
+                 if isinstance(v, (list, tuple)))
+    try:
+        with open(os.path.join(root, "tools", "lint_baseline.json"),
+                  encoding="utf-8") as f:
+            entries = json.load(f).get("findings", [])
+        frozen = str(len(entries))
+        frozen_graph = str(sum(
+            1 for e in entries
+            if str(e.get("file", "")).startswith("graph:")))
+    except Exception:
+        frozen = frozen_graph = "-"
+    try:
+        if root not in sys.path:  # script runs put tools/ first, not root
+            sys.path.insert(0, root)
+        from tools.mxtpu_lint.graphcheck import graph_rule_names
+
+        rules = ", ".join(graph_rule_names())
+    except Exception:
+        rules = "-"
+    lines = ["", "Graph contracts (mxtpu-lint --graph):",
+             f"  pinned sites      {len(sites)} "
+             f"({n_coll} collectives): {', '.join(sorted(sites)) or '-'}",
+             f"  graph rules       {rules}",
+             f"  baseline frozen   {frozen} total"
+             f" ({frozen_graph} graph-leg)"]
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Aggregate a mxnet_tpu telemetry JSONL trace")
@@ -325,6 +372,9 @@ def main(argv=None):
     serving = render_serving(events)
     if serving:
         print(serving)
+    gc = render_graph_contracts()
+    if gc:
+        print(gc)
     if args.steps:
         out = render_steps(events)
         if out:
