@@ -161,6 +161,20 @@ def walkthrough(repo, port):
           st["retraces_after_warmup"] == 0,
           f"(p50 {st['latency_p50_ms']:.1f} ms, "
           f"compiles {st['compiles']})")
+
+    print("== 5. per-phase latency (request-correlated spans: "
+          "queue-wait -> batch-assembly -> dispatch -> slice-out)")
+    phases = mx.observability.serve_slo_snapshot("resnet").get(
+        "phases", {})
+    for phase in ("queue", "batch", "dispatch", "slice"):
+        rec = phases.get(phase)
+        if rec:
+            print(f"  {phase:<9} p50 {rec['p50_s'] * 1e3:7.2f} ms   "
+                  f"p99 {rec['p99_s'] * 1e3:7.2f} ms   "
+                  f"n={rec['count']}")
+    check("phase breakdown covers the request path",
+          all(p in phases for p in ("queue", "batch", "dispatch",
+                                    "slice")))
     return all(checks)
 
 
@@ -171,6 +185,7 @@ def main(argv=None):
                     help="keep the HTTP server up after the walkthrough")
     args = ap.parse_args(argv)
 
+    mx.observability.set_enabled(True)  # phase histograms + request spans
     repo = ModelRepository(keep=1)
     print("deploying resnet18_v1 fp32 (AOT bucket compile + warmup)...")
     repo.load("resnet", build_fp32(), shapes=[ROW], version="fp32",

@@ -40,7 +40,8 @@ def _wire_compile_cache():
     """One-shot env hookups deferred to the first Context so plain
     imports never touch jax config (and the flag keeps
     Context.__init__ to one boolean check afterwards):
-    MXTPU_COMPILE_CACHE, and the MXTPU_METRICS_PORT scrape endpoint."""
+    MXTPU_COMPILE_CACHE, the MXTPU_METRICS_PORT scrape endpoint, the
+    MXTPU_FEDERATION publisher and the MXTPU_WATCHDOG loop."""
     global _CACHE_WIRED
     _CACHE_WIRED = True
     from . import runtime
@@ -49,6 +50,11 @@ def _wire_compile_cache():
     from .observability import serve as _serve
 
     _serve.maybe_serve()
+    from .observability import federation as _federation
+    from .observability import watchdog as _watchdog
+
+    _federation.maybe_start()
+    _watchdog.maybe_start()
 
 
 class Context:

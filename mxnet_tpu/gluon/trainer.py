@@ -274,6 +274,12 @@ class Trainer:
             # device scalar instead, so there is no extra sync at all
             gnorm = self._grad_norm()
         _obs.record_trainer_step(t0, t1, gnorm)
+        if _obs.watchdog.ENABLED:
+            # detector sweep at trainer cadence: a monotonic-clock
+            # compare per step (MXTPU_WATCHDOG_INTERVAL_S gates the
+            # actual sweep) — reads series already recorded above,
+            # never adds a dispatch
+            _obs.watchdog.poll()
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         if not self._kv_initialized:
@@ -1376,6 +1382,11 @@ class Superstep:
             _obs.record_superstep_series(losses, gnorms, it_ovfs)
             if plan["amp"]:
                 _obs.record_amp_lazy(scaler._scale_arr, new_ovf)
+            if _obs.watchdog.ENABLED:
+                # superstep-cadence detector sweep (interval-gated);
+                # the lazy loss/grad series above sync inside the
+                # watchdog, not here — zero added dispatches
+                _obs.watchdog.poll()
         mgr = getattr(tr, "_ckpt_manager", None)
         if mgr is not None:
             # one superstep = K training steps for checkpoint cadence

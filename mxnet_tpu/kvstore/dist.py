@@ -182,6 +182,40 @@ def _global_allreduce_impl(raw):
     return jax.device_put(local, jax.local_devices()[0])
 
 
+def all_gather_bytes(payload: bytes) -> list:
+    """Gather one opaque byte blob from every process; returns the list
+    indexed by rank (single-process: ``[payload]``).
+
+    The metric-federation side-channel (observability/federation.py)
+    rides the EXISTING collective plumbing — ``_global_allreduce`` with
+    disjoint per-rank slots, where sum == gather — instead of growing a
+    second transport next to the data plane. Two reduces: fixed-shape
+    lengths first, then the zero-padded payload matrix. Runs on the
+    federation publisher thread, never the training loop; the host
+    syncs below are the deliberate off-hot-path materialization.
+    """
+    payload = bytes(payload)
+    if jax.process_count() == 1:
+        return [payload]
+    n = jax.process_count()
+    r = jax.process_index()
+
+    ln = _np.zeros((n,), dtype=_np.int32)
+    ln[r] = len(payload)
+    lengths = _np.asarray(  # mxtpu-lint: host-sync-ok
+        _global_allreduce(jnp.asarray(ln)))
+    maxlen = int(lengths.max())
+
+    buf = _np.zeros((n, max(maxlen, 1)), dtype=_np.uint8)
+    if payload:
+        buf[r, : len(payload)] = _np.frombuffer(payload, dtype=_np.uint8)
+    gathered = _np.asarray(  # mxtpu-lint: host-sync-ok
+        _global_allreduce(jnp.asarray(buf)))
+    # jnp.sum promotes uint8 — cast back before slicing out the blobs
+    gathered = gathered.astype(_np.uint8)
+    return [gathered[i, : int(lengths[i])].tobytes() for i in range(n)]
+
+
 @register_kvstore("dist_tpu_sync")
 class KVStoreDistTPU(KVStoreLocal):
     """Synchronous data-parallel store over the global device mesh."""

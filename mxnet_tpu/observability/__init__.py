@@ -423,6 +423,51 @@ SUPERSTEP_ITER_OVERFLOW = _REGISTRY.series_gauge(
     "per-iteration fp16 overflow flag (1 = that iteration skipped its "
     "update) of the last superstep dispatch (lazy device array)")
 
+# -- cluster-scope federation (observability/federation.py) ----------------
+
+FEDERATION_PUBLISH_TOTAL = _REGISTRY.counter(
+    "mxtpu_federation_publish_total",
+    "registry snapshots this rank published onto the kvstore "
+    "side-channel (the federation publisher heartbeat)")
+FEDERATION_ERRORS_TOTAL = _REGISTRY.counter(
+    "mxtpu_federation_errors_total",
+    "failed federation exchanges (the publisher degraded to a "
+    "local-only publish; the cluster view goes stale, never dark)")
+FEDERATION_RANKS = _REGISTRY.gauge(
+    "mxtpu_federation_ranks",
+    "ranks with a snapshot in the cluster table (compare against the "
+    "world size: fewer means someone stopped publishing)")
+FEDERATION_SNAPSHOT_AGE_SECONDS = _REGISTRY.gauge(
+    "mxtpu_federation_snapshot_age_seconds",
+    "age of each rank's latest federated snapshot, by rank")
+FEDERATION_STALE_RANKS = _REGISTRY.gauge(
+    "mxtpu_federation_stale_ranks",
+    "1 when the rank's snapshot age exceeds MXTPU_FEDERATION_STALE_S "
+    "(its last series stay exposed — marked, never silently dropped), "
+    "by rank")
+FEDERATION_LAST_STEP = _REGISTRY.gauge(
+    "mxtpu_federation_last_step",
+    "step-epoch id carried by each rank's latest snapshot, by rank — "
+    "the cross-rank skew/straggler picture (max - min = steps of lag)")
+
+# -- anomaly watchdog (observability/watchdog.py, MXTPU_WATCHDOG) ----------
+
+ANOMALY_TOTAL = _REGISTRY.counter(
+    "mxtpu_anomaly_total",
+    "watchdog detector firings, by kind (nan / loss_spike / "
+    "grad_explosion / step_time / queue_saturation) — detection only, "
+    "training numerics are never touched")
+
+# -- serving request-phase decomposition (correlated tracing) --------------
+
+SERVE_PHASE_SECONDS = _REGISTRY.histogram(
+    "mxtpu_serving_phase_seconds",
+    "per-request latency by phase (queue / batch / dispatch / slice), "
+    "by model — decomposes the end-to-end p99 into where the time "
+    "actually went",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)
@@ -579,15 +624,17 @@ def record_h2d(nbytes: int, dt: float, depth: int):
 
 
 def record_serve_batch(model: str, bucket, n_valid: int, capacity: int,
-                       dt: float, depth: int):
+                       dt: float, depth: int, span_id=None):
     """One continuous-batching dispatch (mxnet_tpu/serving): batch-fill
-    + queue-depth accounting and the per-batch trace span."""
+    + queue-depth accounting and the per-batch trace span. ``span_id``
+    (minted by the engine) parents the batch's per-request phase
+    spans."""
     fill = n_valid / max(capacity, 1)
     SERVE_BATCHES_TOTAL.inc(1, model=model, bucket=str(bucket))
     SERVE_BATCH_FILL.observe(fill, model=model)
     SERVE_QUEUE_DEPTH.set(depth, model=model)
     _TRACER.record("serving.batch", cat="serving",
-                   ts=_time.perf_counter() - dt, dur=dt,
+                   ts=_time.perf_counter() - dt, dur=dt, span_id=span_id,
                    args={"model": model, "bucket": str(bucket),
                          "n_valid": int(n_valid), "capacity": int(capacity),
                          "fill": round(fill, 4), "queue_depth": int(depth)})
@@ -618,6 +665,53 @@ def record_serve_swap(model: str, outcome: str, version=None,
                     prev_version=str(prev_version))
 
 
+def record_serve_submit(model: str, req_id: int):
+    """Request-id birth: one instant event at ``submit`` so the id is
+    traceable from ingress, before any batcher thread touches it."""
+    _TRACER.instant("serving.submit", cat="serving", model=model,
+                    req=int(req_id))
+
+
+def record_serve_phases(model: str, req_id: int, t_submit: float,
+                        phases: dict, parent=None):
+    """Per-request phase decomposition (queue-wait -> batch-assembly ->
+    dispatch -> slice-out): observes each phase into
+    ``mxtpu_serving_phase_seconds`` and records one ``serving.request``
+    child span carrying the request id + its parent batch span id —
+    the correlated-trace leg that makes p99 decomposable."""
+    args = {"model": model, "req": int(req_id)}
+    if parent is not None:
+        args["parent"] = int(parent)
+    total = 0.0
+    for phase, dur in phases.items():
+        if dur is None:
+            continue
+        dur = max(float(dur), 0.0)
+        total += dur
+        SERVE_PHASE_SECONDS.observe(dur, model=model, phase=phase)
+        args[f"{phase}_ms"] = round(dur * 1e3, 3)
+    _TRACER.record("serving.request", cat="serving", ts=t_submit,
+                   dur=total, args=args)
+
+
+def serve_phase_snapshot(model: str) -> dict:
+    """p50/p99 per phase for ``model`` from the request-span histogram
+    (empty until the engine served its first batch)."""
+    out = {}
+    for phase in ("queue", "batch", "dispatch", "slice"):
+        n = SERVE_PHASE_SECONDS.value(model=model, phase=phase)
+        if not n:
+            continue
+        out[phase] = {
+            "p50_s": SERVE_PHASE_SECONDS.quantile(0.5, model=model,
+                                                  phase=phase),
+            "p99_s": SERVE_PHASE_SECONDS.quantile(0.99, model=model,
+                                                  phase=phase),
+            "count": n,
+        }
+    return out
+
+
 def serve_slo_snapshot(model: str) -> dict:
     """p50/p99 latency + request/batch counters for ``model`` as plain
     floats (reads the histograms — off the hot path by construction)."""
@@ -635,6 +729,7 @@ def serve_slo_snapshot(model: str) -> dict:
         "shed": SERVE_SHED_TOTAL.value(model=model),
         "timeouts": SERVE_TIMEOUT_TOTAL.value(model=model),
         "compiles": SERVE_COMPILE_TOTAL.value(model=model),
+        "phases": serve_phase_snapshot(model),
     }
 
 
@@ -741,6 +836,8 @@ from .serve import (  # noqa: E402,F401
     serve_metrics,
     stop_metrics_server,
 )
+from . import federation  # noqa: E402,F401
+from . import watchdog  # noqa: E402,F401
 
 # MXTPU_DUMP_ON_CRASH: hooks install at import (opt-in via env only —
 # without the var this is a dict read and nothing else)

@@ -4,6 +4,9 @@
 server exposing the existing text exposition:
 
 - ``GET /metrics``  -> ``dump_prometheus()`` (text/plain; version 0.0.4)
+- ``GET /metrics/cluster`` -> the federated job-scope exposition
+  (every rank's series under ``rank="r"`` + aggregates; see
+  ``observability/federation.py``)
 - ``GET /healthz``  -> ``ok`` (liveness — answers even mid-step, since
   the server thread never touches the device)
 
@@ -44,6 +47,21 @@ def _make_handler():
                     body = dump_prometheus().encode()
                 except Exception as e:  # scrape must not kill the server
                     self.send_error(500, f"exposition failed: {e}")
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path.split("?")[0] == "/metrics/cluster":
+                from .federation import dump_prometheus_cluster
+
+                try:
+                    body = dump_prometheus_cluster().encode()
+                except Exception as e:  # scrape must not kill the server
+                    self.send_error(500, f"cluster exposition failed: {e}")
                     return
                 self.send_response(200)
                 self.send_header(

@@ -18,6 +18,7 @@ tracer construction (chrome://tracing only needs monotonicity).
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import os
 import threading
@@ -60,6 +61,9 @@ class Tracer:
             maxlen=capacity or _default_capacity())
         self._epoch = time.perf_counter()
         self.step = 0  # advanced by Trainer.step via mark_step()
+        # span ids: process-unique, monotonic, survive clear() — parent
+        # links recorded before a clear must not collide after it
+        self._span_ids = itertools.count(1)
 
     # -- recording -------------------------------------------------------
     def mark_step(self) -> int:
@@ -68,16 +72,24 @@ class Tracer:
         self.step += 1
         return self.step
 
+    def new_span_id(self) -> int:
+        """A process-unique span id (itertools.count — GIL-atomic).
+        Correlated child events reference it via ``args["parent"]``."""
+        return next(self._span_ids)
+
     def record(self, name, cat="default", ts=None, dur=0.0, args=None,
-               ph="X"):
+               ph="X", span_id=None):
         """Append one event. ``ts``/``dur`` are perf_counter seconds
-        (``ts=None`` means now)."""
+        (``ts=None`` means now). Every event carries a unique ``id``
+        (pass ``span_id`` to stamp one minted earlier, e.g. before
+        handing it to children as their parent)."""
         if ts is None:
             ts = time.perf_counter()
         ev = {
             "name": name,
             "cat": cat,
             "ph": ph,
+            "id": int(span_id) if span_id is not None else self.new_span_id(),
             "ts": (ts - self._epoch) * 1e6,
             "dur": dur * 1e6,
             "pid": os.getpid(),

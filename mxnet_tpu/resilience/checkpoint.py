@@ -754,6 +754,14 @@ class CheckpointManager:
             raise MXNetError("CheckpointManager.attach: no trainer")
         self._trainer = tr
         tr._ckpt_manager = self
+        # hand the anomaly watchdog a save path: with
+        # MXTPU_WATCHDOG_CHECKPOINT=1 a detector firing requests one
+        # proactive async save (the recovery point moves BEFORE the
+        # divergence kills the job)
+        from ..observability import watchdog as _watchdog
+
+        if _watchdog.ENABLED:
+            _watchdog.attach_checkpoint_manager(self)
         return self
 
     def on_step(self, n=1, cursor=None):

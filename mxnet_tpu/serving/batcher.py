@@ -19,6 +19,7 @@ queueing unbounded work the deadline would kill anyway.
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 import time
@@ -29,6 +30,11 @@ from .errors import EngineClosed, ServerOverloaded
 #: so the drain processes everything admitted before the close.
 _CLOSE = object()
 
+#: process-unique request ids, minted at construction (itertools.count
+#: is GIL-atomic) — the correlation key the trace spans thread through
+#: queue-wait -> batch-assembly -> dispatch -> slice-out
+_REQ_IDS = itertools.count(1)
+
 
 class _Request:
     """One in-flight request: host payload rows (already padded onto
@@ -36,7 +42,8 @@ class _Request:
     event its :class:`ServeFuture` blocks on."""
 
     __slots__ = ("payload", "rows", "bucket", "t_submit", "deadline",
-                 "event", "result", "error", "version")
+                 "event", "result", "error", "version", "req_id",
+                 "t_assembly")
 
     def __init__(self, payload, rows, bucket, deadline=None):
         self.payload = payload
@@ -48,6 +55,8 @@ class _Request:
         self.result = None
         self.error = None
         self.version = None
+        self.req_id = next(_REQ_IDS)
+        self.t_assembly = None  # stamped when batch assembly picks it up
 
     def finish(self, result=None, error=None):
         self.result = result
@@ -69,6 +78,12 @@ class ServeFuture:
         """The model version that answered (set with the result) —
         exactly one coherent version per request, even mid-swap."""
         return self._req.version
+
+    @property
+    def req_id(self) -> int:
+        """The request's correlation id — the key its trace spans
+        (``serving.submit`` / ``serving.request``) carry."""
+        return self._req.req_id
 
     def result(self, timeout=None):
         """Block for the outcome; raises the request's typed error

@@ -286,6 +286,8 @@ class InferenceEngine:
             time.perf_counter() + float(deadline_ms) / 1e3
         req = _Request(arr, rows, bucket, deadline=deadline)
         req.version = self._version
+        if _obs.ENABLED:
+            _obs.record_serve_submit(self._name, req.req_id)
         try:
             self._batcher.submit(req)
         except ServerOverloaded:
@@ -318,6 +320,10 @@ class InferenceEngine:
         if compiled is None:  # cannot happen post-seal; refuse, not trace
             raise RetraceForbidden(
                 f"no executable for bucket {bucket} (engine sealed)")
+        # phase boundary 1: queue-wait ends, batch assembly begins
+        t_asm = time.perf_counter()
+        for r in reqs:
+            r.t_assembly = t_asm
         stacked = _np.concatenate([r.payload for r in reqs], axis=0) \
             if len(reqs) > 1 else reqs[0].payload
         n_valid = int(stacked.shape[0])
@@ -352,9 +358,23 @@ class InferenceEngine:
         self._batches += 1
         self._fill_sum += n_valid / self._max_batch
         if _obs.ENABLED:
+            t_done = time.perf_counter()
+            # one batch span id parents every request's phase span —
+            # the correlated-trace join key (queue -> batch -> dispatch
+            # -> slice, per request; p99 becomes decomposable)
+            batch_span = _obs.tracer().new_span_id()
+            for r in reqs:
+                _obs.record_serve_phases(
+                    self._name, r.req_id, r.t_submit,
+                    {"queue": t_asm - r.t_submit,
+                     "batch": t0 - t_asm,
+                     "dispatch": dt,
+                     "slice": t_done - now},
+                    parent=batch_span)
             _obs.record_serve_batch(self._name, bucket, n_valid,
                                     self._max_batch, dt,
-                                    self._batcher.qsize())
+                                    self._batcher.qsize(),
+                                    span_id=batch_span)
 
     # -- introspection -----------------------------------------------------
     @property

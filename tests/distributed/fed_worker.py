@@ -1,0 +1,78 @@
+"""Multi-process metric-federation worker (PR-15 tentpole).
+
+Spawned by ``tests/distributed/test_dist_tpu_sync.py`` via
+``tools/launch.py -n N``. Every rank emits a rank-distinct counter
+value, runs one ``federation.exchange()`` over the kvstore collective
+side-channel, and asserts — ON EVERY RANK (the gather is symmetric) —
+that the merged cluster table carries every peer's series plus the
+job-level aggregates."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import re
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability as obs
+from mxnet_tpu.kvstore.dist import init_distributed
+from mxnet_tpu.observability import federation as fed
+
+init_distributed()  # picks up the MXTPU_* env contract from tools/launch.py
+
+rank = int(os.environ["MXTPU_PROCESS_ID"])
+nworkers = int(os.environ["MXTPU_NUM_PROCESSES"])
+assert jax.process_count() == nworkers, (jax.process_count(), nworkers)
+assert jax.process_index() == rank
+
+kv = mx.kv.create("dist_tpu_sync")  # warms the collective channel
+
+obs.set_enabled(True)
+obs.TRAINER_STEP_TOTAL.inc(rank + 1)           # rank-distinct counter
+obs.TRAINER_GRAD_NORM.set(float(rank + 1))     # rank-distinct gauge
+obs.TRAINER_STEP_SECONDS.observe(0.01 * (rank + 1))
+for _ in range(rank + 1):
+    obs.tracer().mark_step()                   # rank-distinct step_epoch
+
+got = fed.exchange()
+assert got == nworkers, (got, nworkers)
+assert fed.cluster_ranks() == list(range(nworkers)), fed.cluster_ranks()
+
+text = fed.cluster_registry().dump_prometheus()
+
+
+def val(metric, **labels):
+    want = "{" + ",".join(f'{k}="{v}"' for k, v in
+                          sorted(labels.items())) + "}"
+    m = re.search(re.escape(metric + want) + r" ([-0-9.e+]+)", text)
+    assert m, f"{metric}{want} missing from cluster exposition"
+    return float(m.group(1))
+
+
+# every peer's series present, labeled by its rank
+for r in range(nworkers):
+    assert val("mxtpu_trainer_step_total", rank=str(r)) == r + 1
+# counters SUM across ranks
+assert val("mxtpu_trainer_step_total",
+           rank="all") == nworkers * (nworkers + 1) / 2
+# gauges aggregate min/max across ranks
+assert val("mxtpu_trainer_grad_norm", agg="min", rank="all") == 1.0
+assert val("mxtpu_trainer_grad_norm", agg="max", rank="all") == nworkers
+# histograms merge: the job count is the sum of per-rank counts
+assert val("mxtpu_trainer_step_seconds_count", rank="all") == nworkers
+
+# per-rank step_epoch rode the snapshots (the cross-rank skew picture)
+stale = fed.update_cluster_meta()
+assert stale == [], stale
+assert obs.FEDERATION_LAST_STEP.value(rank=str(nworkers - 1)) == nworkers
+
+kv.barrier()  # nobody exits before every rank finished asserting
+print(f"FED_WORKER_OK rank={rank}/{nworkers}", flush=True)

@@ -264,3 +264,31 @@ def test_pp_ep_multiprocess_multidevice(nprocs, ndev):
     import numpy as _np
 
     _np.testing.assert_allclose(got_vals, ref_vals, rtol=1e-5)
+
+
+FED_WORKER = os.path.join(ROOT, "tests", "distributed", "fed_worker.py")
+
+
+@pytest.mark.dist_baseline
+def test_metric_federation_multiprocess():
+    """PR15 tentpole: cross-rank metric federation rides the kvstore
+    collective side-channel on a REAL 2-process world — one
+    ``exchange()`` and every rank's cluster table carries every peer's
+    series plus the job aggregates (the worker asserts per rank; the
+    single-process merge semantics are pinned in
+    ``tests/test_federation.py``)."""
+    res = _launch(FED_WORKER, 2, timeout=600)
+    if res.returncode != 0 and \
+            "Multiprocess computations aren't implemented" in res.stderr:
+        # the documented environmental limitation behind the
+        # dist_baseline failures (this container's XLA:CPU cannot run
+        # cross-process collectives) — the single-process federation
+        # suite already pinned snapshot/merge/exposition semantics
+        pytest.skip("XLA:CPU cannot run multiprocess collectives here "
+                    "(dist_baseline environment)")
+    assert res.returncode == 0, (
+        f"launcher rc={res.returncode}\nstdout:\n{res.stdout[-4000:]}\n"
+        f"stderr:\n{res.stderr[-4000:]}")
+    for rank in range(2):
+        assert f"FED_WORKER_OK rank={rank}/2" in res.stdout, (
+            f"rank {rank} missing OK line\nstdout:\n{res.stdout[-4000:]}")

@@ -35,6 +35,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR10_OUT"] = str(tmp_path / "BENCH_pr10.json")
     env["BENCH_PR11_OUT"] = str(tmp_path / "BENCH_pr11.json")
     env["BENCH_PR13_OUT"] = str(tmp_path / "BENCH_pr13.json")
+    env["BENCH_PR15_OUT"] = str(tmp_path / "BENCH_pr15.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -67,6 +68,11 @@ def _serving_rec(recs):
     return sv[0] if sv else None
 
 
+def _federation_rec(recs):
+    fd = [r for r in recs if r["metric"].startswith("federation_plane")]
+    return fd[0] if fd else None
+
+
 #: the shared BENCH_ONLY re-run contract: a timing/pressure-sensitive
 #: assert that fails during the FULL run gets exactly one clean-
 #: subprocess retry of JUST its scenario (host pressure across a 10-
@@ -80,6 +86,7 @@ _STANDALONE = {
     "overlap": (_overlap_rec, ("BENCH_PR10_OUT",)),
     "elastic": (_elastic_rec, ("BENCH_PR11_OUT",)),
     "serving": (_serving_rec, ("BENCH_PR13_OUT",)),
+    "federation": (_federation_rec, ("BENCH_PR15_OUT",)),
 }
 
 
@@ -210,6 +217,58 @@ def test_bench_emits_driver_contract(tmp_path):
         assert sv and sv["recompiles_after_warmup"] == 0 \
             and (sv.get("speedup_vs_single") or 0) > 1.0, \
             (sv, res.stderr[-1000:], res2.stderr[-1000:])
+    # observability-plane scenario (PR15): federation + watchdog armed
+    # over a real 4-device train loop. The structural gates are HARD
+    # (zero added dispatches, 4 ranks federated, cluster endpoint +
+    # aggregates + exact histogram merge + stale marking + exactly-once
+    # NaN anomaly); the telemetry overhead number is the one pressure-
+    # sensitive figure — it gets the standalone retry.
+    fd = _federation_rec(recs)
+    assert fd, names
+    assert fd["dispatch_delta"] == 0, fd
+    assert fd["ranks_federated"] == 4, fd
+    for flag in ("cluster_endpoint_ok", "aggregates_ok",
+                 "histogram_merge_ok", "stale_marked",
+                 "watchdog_nan_exactly_once"):
+        assert fd[flag] is True, (flag, fd)
+    if not fd["overhead_pct"] < 2.0:
+        fd, res2 = _rerun_standalone(env, "federation")
+        assert fd and fd["overhead_pct"] < 2.0 \
+            and fd["dispatch_delta"] == 0, \
+            (fd, res.stderr[-1000:], res2.stderr[-1000:])
+    pr15 = json.load(open(tmp_path / "BENCH_pr15.json"))
+    assert pr15["scenario"] == "federation" \
+        and pr15["ranks_federated"] == 4 \
+        and pr15["dispatch_delta"] == 0, pr15
+    # the bench regression gate (tools/bench_diff.py) closes the loop:
+    # the fresh record passes against the committed trajectory (wide
+    # band — CPU hosts differ), and a doctored -30% throughput copy
+    # FAILS at the default band (the gate actually gates)
+    import subprocess as sp
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   str(tmp_path / "BENCH_pr15.json"),
+                   os.path.join(ROOT, "BENCH_pr15.json"),
+                   "--tolerance", "0.8", "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 0, (diff.stdout, diff.stderr)
+    verdict = json.loads(diff.stdout)
+    assert verdict["pass"] and verdict["checked"] > 0, verdict
+    doctored = dict(pr15)
+    doctored["steps_per_sec_federated"] = \
+        pr15["steps_per_sec_federated"] * 0.7
+    doc_path = tmp_path / "BENCH_pr15_doctored.json"
+    doc_path.write_text(json.dumps(doctored))
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   str(doc_path), str(tmp_path / "BENCH_pr15.json"),
+                   "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 1, (diff.returncode, diff.stdout)
+    verdict = json.loads(diff.stdout)
+    assert not verdict["pass"] and any(
+        f["key"] == "steps_per_sec_federated"
+        for f in verdict["failures"]), verdict
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs

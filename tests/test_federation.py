@@ -1,0 +1,369 @@
+"""Cross-rank metric federation (observability.federation): snapshot /
+ingest / merge semantics on a forced multi-device CPU mesh, exact
+histogram bucket merging, stale-rank marking, the /metrics/cluster
+endpoint, and the zero-added-dispatch contract with the whole
+observability plane (publisher + watchdog) armed.
+
+The REAL multi-process exchange leg lives in
+``tests/distributed/test_dist_tpu_sync.py::test_federation_multiprocess``
+(fed_worker.py under tools/launch.py); these tests pin the merge and
+exposition semantics the exchange feeds.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, observability as obs
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.observability import federation as fed
+from mxnet_tpu.observability import watchdog as wd
+
+TOOLS = os.path.join(os.path.dirname(__file__), os.pardir, "tools")
+
+
+@pytest.fixture(autouse=True)
+def _federation_state():
+    """Every test starts from an empty cluster table and a clean,
+    enabled registry; nothing leaks into the tier-1 process state."""
+    obs.set_enabled(True)
+    obs.reset()
+    fed.stop()
+    fed.reset()
+    wd.reset()
+    yield
+    fed.stop()
+    fed.reset()
+    wd.set_enabled(False)
+    wd.reset()
+    obs.stop_metrics_server()
+    obs.set_enabled(False)
+    obs.reset()
+
+
+def _clone(snap):
+    """JSON round-trip — exactly what the wire does to a snapshot."""
+    return json.loads(json.dumps(snap))
+
+
+def _peer(local, rank, **overrides):
+    p = _clone(local)
+    p["rank"] = rank
+    p.update(overrides)
+    return p
+
+
+def _val(text, metric, **labels):
+    want = "{" + ",".join(f'{k}="{v}"' for k, v in
+                          sorted(labels.items())) + "}"
+    m = re.search(re.escape(metric + want) + r" ([-0-9.e+]+|nan|inf)",
+                  text)
+    return float(m.group(1)) if m else None
+
+
+# ---------------------------------------------------------------------------
+# snapshot / side-channel plumbing
+# ---------------------------------------------------------------------------
+
+def test_all_gather_bytes_single_process_identity():
+    from mxnet_tpu.kvstore.dist import all_gather_bytes
+
+    assert all_gather_bytes(b"payload") == [b"payload"]
+    assert all_gather_bytes(b"") == [b""]
+
+
+def test_snapshot_carries_every_metric_kind():
+    obs.TRAINER_STEP_TOTAL.inc(3)
+    obs.TRAINER_GRAD_NORM.set(1.5)
+    obs.TRAINER_STEP_SECONDS.observe(0.02)
+    obs.SUPERSTEP_ITER_LOSS.set_series([0.5, 0.4])
+    snap = fed.snapshot()
+    assert snap["rank"] == 0
+    assert isinstance(snap["step_epoch"], int)
+    m = snap["metrics"]
+    assert m["mxtpu_trainer_step_total"]["kind"] == "counter"
+    assert m["mxtpu_trainer_grad_norm"]["kind"] == "gauge"
+    assert m["mxtpu_trainer_step_seconds"]["kind"] == "histogram"
+    assert m["mxtpu_trainer_step_seconds"]["buckets"]
+    assert m["mxtpu_superstep_iter_loss"]["kind"] == "series_gauge"
+    # a snapshot survives the JSON wire intact
+    assert _clone(snap) == json.loads(json.dumps(snap))
+
+
+def test_ingest_and_cluster_ranks():
+    obs.TRAINER_STEP_TOTAL.inc()
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+    fed.ingest(_peer(local, 2))
+    fed.ingest(_peer(local, 1))
+    assert fed.cluster_ranks() == [0, 1, 2]
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+def test_cluster_counter_sum_and_gauge_aggregates():
+    obs.TRAINER_STEP_TOTAL.inc(5)
+    obs.TRAINER_GRAD_NORM.set(2.0)
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+    for rank, (steps, gn) in ((1, (7.0, 6.0)), (2, (4.0, 1.0))):
+        p = _peer(local, rank)
+        p["metrics"]["mxtpu_trainer_step_total"]["values"]["[]"] = steps
+        p["metrics"]["mxtpu_trainer_grad_norm"]["values"]["[]"] = gn
+        fed.ingest(p)
+    text = fed.cluster_registry().dump_prometheus()
+    # per-rank series with the rank label
+    assert _val(text, "mxtpu_trainer_step_total", rank="0") == 5
+    assert _val(text, "mxtpu_trainer_step_total", rank="1") == 7
+    # job aggregate: counters SUM
+    assert _val(text, "mxtpu_trainer_step_total", rank="all") == 16
+    # job aggregate: gauges min / median / max
+    assert _val(text, "mxtpu_trainer_grad_norm",
+                agg="min", rank="all") == 1.0
+    assert _val(text, "mxtpu_trainer_grad_norm",
+                agg="median", rank="all") == 2.0
+    assert _val(text, "mxtpu_trainer_grad_norm",
+                agg="max", rank="all") == 6.0
+
+
+def test_cluster_histogram_merge_is_exact():
+    """The rank="all" histogram must be the element-wise bucket sum —
+    byte-exact against a local histogram that observed the union."""
+    vals0 = (0.0005, 0.003, 0.2)
+    vals1 = (0.0007, 0.05, 3.0, 0.00005)
+    for v in vals0:
+        obs.TRAINER_STEP_SECONDS.observe(v)
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+
+    # rank 1 observed a different set: build its record through a real
+    # histogram with the same bucket layout (no hand-rolled records)
+    scratch = obs.MetricsRegistry()
+    h1 = scratch.histogram("h1", buckets=obs.TRAINER_STEP_SECONDS.buckets)
+    for v in vals1:
+        h1.observe(v)
+    p = _peer(local, 1)
+    p["metrics"]["mxtpu_trainer_step_seconds"]["values"]["[]"] = [
+        float(x) for x in h1._values[()]]
+    fed.ingest(p)
+
+    reg = fed.cluster_registry()
+    merged = reg.histogram("mxtpu_trainer_step_seconds")
+    got = merged._values[(("rank", "all"),)]
+
+    ref_reg = obs.MetricsRegistry()
+    ref = ref_reg.histogram("ref", buckets=obs.TRAINER_STEP_SECONDS.buckets)
+    for v in vals0 + vals1:
+        ref.observe(v)
+    expect = list(ref._values[()])
+    assert got[:-2] == expect[:-2]                     # bucket counts
+    assert got[-1] == expect[-1] == len(vals0) + len(vals1)
+    assert got[-2] == pytest.approx(expect[-2])        # sum (float)
+    # quantiles over the merged series match the union-observed ones
+    assert merged.quantile(0.5, rank="all") == \
+        pytest.approx(ref.quantile(0.5))
+
+
+def test_cluster_histogram_bucket_mismatch_degrades():
+    """Disagreeing bucket layouts must NOT fabricate an aggregate —
+    per-rank series stay, the rank="all" row is absent."""
+    obs.TRAINER_STEP_SECONDS.observe(0.01)
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+    p = _peer(local, 1)
+    ent = p["metrics"]["mxtpu_trainer_step_seconds"]
+    ent["buckets"] = [0.1, 1.0]
+    ent["values"]["[]"] = [1.0, 0.0, 0.0, 0.01, 1.0]
+    fed.ingest(p)
+    text = fed.cluster_registry().dump_prometheus()
+    assert _val(text, "mxtpu_trainer_step_seconds_count", rank="0") == 1
+    # the foreign layout can't be rendered against our `le` edges and
+    # must not fabricate a job aggregate — but it must not crash the
+    # scrape either (dump_prometheus above IS the assertion for that)
+    assert _val(text, "mxtpu_trainer_step_seconds_count",
+                rank="1") is None
+    assert _val(text, "mxtpu_trainer_step_seconds_count",
+                rank="all") is None
+
+
+def test_series_gauges_stay_per_rank():
+    obs.SUPERSTEP_ITER_LOSS.set_series([0.5, 0.4])
+    fed.publish_local()
+    fed.ingest(_peer(_clone(fed.snapshot()), 1))
+    text = fed.cluster_registry().dump_prometheus()
+    assert _val(text, "mxtpu_superstep_iter_loss",
+                rank="1", slot="0") == 0.5
+    # no fabricated job-level aggregate for per-dispatch series
+    assert 'mxtpu_superstep_iter_loss{rank="all"' not in text
+
+
+# ---------------------------------------------------------------------------
+# staleness
+# ---------------------------------------------------------------------------
+
+def test_stale_rank_marked_never_dropped():
+    obs.TRAINER_STEP_TOTAL.inc(2)
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+    fed.ingest(_peer(local, 1), recv_mono=time.monotonic() - 999.0)
+    fed.ingest(_peer(local, 2))
+    assert fed.update_cluster_meta() == [1]
+    text = fed.dump_prometheus_cluster()
+    # the stale rank's last-known series are STILL exposed
+    assert _val(text, "mxtpu_trainer_step_total", rank="1") == 2
+    # ... and the marker gauge says so (observed rank -> peer label)
+    assert _val(text, "mxtpu_federation_stale_ranks",
+                peer="1", rank="0") == 1.0
+    assert _val(text, "mxtpu_federation_stale_ranks",
+                peer="2", rank="0") == 0.0
+    # per-rank snapshot age + step epoch ride the same meta gauges
+    assert _val(text, "mxtpu_federation_snapshot_age_seconds",
+                peer="1", rank="0") >= 999.0
+    assert _val(text, "mxtpu_federation_last_step",
+                peer="2", rank="0") is not None
+
+
+def test_stale_detection_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("MXTPU_FEDERATION_STALE_S", "0")
+    fed.publish_local()
+    fed.ingest(_peer(_clone(fed.snapshot()), 1),
+               recv_mono=time.monotonic() - 99999.0)
+    assert fed.stale_ranks() == []
+
+
+# ---------------------------------------------------------------------------
+# endpoint + bundle
+# ---------------------------------------------------------------------------
+
+def test_metrics_cluster_endpoint():
+    obs.TRAINER_STEP_TOTAL.inc(3)
+    fed.publish_local()
+    fed.ingest(_peer(_clone(fed.snapshot()), 1))
+    port = obs.serve_metrics(0, host="127.0.0.1")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics/cluster", timeout=10) as r:
+        assert r.status == 200
+        body = r.read().decode()
+    # plain /metrics still serves the local, unlabeled view
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+        local_body = r.read().decode()
+    assert _val(body, "mxtpu_trainer_step_total", rank="0") == 3
+    assert _val(body, "mxtpu_trainer_step_total", rank="all") == 6
+    assert "mxtpu_trainer_step_total 3" in local_body
+
+
+def test_dump_cluster_snapshot_renders_in_report(tmp_path):
+    """The JSON bundle feeds tools/telemetry_report.py: the new Cluster
+    and Anomalies sections render alongside the existing table."""
+    obs.TRAINER_STEP_TOTAL.inc()
+    wd.set_enabled(True)
+    obs.SUPERSTEP_ITER_LOSS.set_series([float("nan")])
+    obs.tracer().mark_step()
+    assert "nan" in wd.check_now()
+    fed.publish_local()
+    local = _clone(fed.snapshot())
+    fed.ingest(_peer(local, 1, step_epoch=local["step_epoch"] - 3),
+               recv_mono=time.monotonic() - 999.0)
+    path = str(tmp_path / "bundle.json")
+    fed.dump_cluster_snapshot(path)
+    res = subprocess.run(
+        [sys.executable, os.path.join(TOOLS, "telemetry_report.py"), path],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "Cluster (federated snapshots):" in res.stdout
+    assert "STALE" in res.stdout
+    assert "Anomalies (watchdog):" in res.stdout
+    assert re.search(r"nan: 1 firing", res.stdout)
+
+
+# ---------------------------------------------------------------------------
+# publisher thread + the zero-dispatch contract
+# ---------------------------------------------------------------------------
+
+def test_publisher_thread_idempotent_start_stop():
+    assert fed.start(interval=0.02) is True
+    assert fed.start(interval=0.02) is False  # already running
+    deadline = time.monotonic() + 5.0
+    while obs.FEDERATION_PUBLISH_TOTAL.total() < 2:
+        assert time.monotonic() < deadline, "publisher never ticked"
+        time.sleep(0.01)
+    fed.stop()
+    fed.stop()  # idempotent
+    assert fed.cluster_ranks() == [0]
+    assert _val(fed.dump_prometheus_cluster(),
+                "mxtpu_federation_ranks", rank="0") == 1
+
+
+def test_maybe_start_respects_env(monkeypatch):
+    monkeypatch.delenv("MXTPU_FEDERATION", raising=False)
+    fed.maybe_start()
+    assert not fed.federation_enabled()
+    monkeypatch.setenv("MXTPU_FEDERATION", "1")
+    assert fed.federation_enabled()
+    fed.maybe_start()
+    try:
+        assert fed.start() is False  # maybe_start already took the slot
+    finally:
+        fed.stop()
+
+
+def _tiny_net(in_units=8, hidden=16, classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu", in_units=in_units),
+            nn.Dense(classes, in_units=hidden))
+    net.initialize()
+    return net
+
+
+def test_observability_plane_adds_zero_dispatches():
+    """THE hot-path contract: federation publisher + watchdog armed add
+    exactly zero XLA dispatches per training step (same template as
+    test_observability.py's telemetry gate)."""
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    net = _tiny_net()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9},
+                       kvstore=None)
+    X, Y = mx.nd.ones((8, 8)), mx.nd.zeros((8,))
+
+    def one():
+        with autograd.record():
+            l = loss_fn(net(X), Y)
+        l.backward()
+        tr.step(8)
+        return l
+
+    one()
+    engine.wait(one().data)  # warm: compile fwd/bwd/update
+    c0 = obs.XLA_DISPATCH_TOTAL.total()
+    engine.wait(one().data)
+    per_step = obs.XLA_DISPATCH_TOTAL.total() - c0  # steady-state cost
+
+    wd.set_enabled(True)
+    wd.reset()
+    fed.start(interval=0.02)  # aggressive cadence: force real traffic
+    try:
+        time.sleep(0.05)
+        N = 20
+        c0 = obs.XLA_DISPATCH_TOTAL.total()
+        l = None
+        for _ in range(N):
+            l = one()
+        engine.wait(l.data)
+        delta = obs.XLA_DISPATCH_TOTAL.total() - c0
+    finally:
+        fed.stop()
+        wd.set_enabled(False)
+    assert delta == per_step * N, (delta, per_step, N)
+    assert obs.FEDERATION_PUBLISH_TOTAL.total() >= 1

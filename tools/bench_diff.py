@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Diff a fresh bench result against the checked-in trajectory.
+
+The measured trajectory (``BENCH_*.json``, one per PR) finally gets a
+machine gate: a throughput regression fails CI instead of shipping
+silently inside a green run.
+
+Usage::
+
+    python tools/bench_diff.py NEW BASELINE [--tolerance 0.2]
+        [--metric-tolerance NAME=FRAC ...] [--json]
+
+Inputs (both sides must be the same shape):
+
+- a ``BENCH_pr<N>.json`` scenario object — every numeric field is
+  compared (dotted keys for nested dicts); keys starting with ``_``
+  are informational (wall-clock noise) and excluded from the gate;
+  booleans must match exactly;
+- a bench emit-row JSONL (``bench.py`` driver output) — rows join on
+  their ``metric`` name and compare ``value`` with unit-aware
+  direction.
+
+Direction-aware bands (default ±20% — CPU benches are noisy):
+throughput-like metrics fail only when they DROP below
+``baseline * (1 - tol)``; latency-like metrics fail only when they
+RISE above ``baseline * (1 + tol)``; unclassified metrics use the
+symmetric band. Near-zero baselines (|x| < 1e-9) are skipped — a
+ratio against zero is meaningless.
+
+Exit codes: 0 pass, 1 regression(s), 2 usage/input error. ``--json``
+prints a machine-readable verdict on stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+DEFAULT_TOLERANCE = 0.2
+_NEAR_ZERO = 1e-9
+
+#: direction classification by metric/field name (checked in order:
+#: higher-better first, so "throughput_ms" style collisions resolve to
+#: the more specific throughput intent last via the unit instead)
+_HIGHER_BETTER = re.compile(
+    r"(throughput|per_s|_qps|qps_|speedup|reduction|recovered|hidden"
+    r"|fraction|_mfu|mfu_|fill|ranks|ok$|_ok_)", re.I)
+_LOWER_BETTER = re.compile(
+    r"(_ms|_s$|_us|seconds|latency|overhead|_time|time_|p50|p99|p999"
+    r"|lost|miss|stale|errors|skew|wait|age|exposed)", re.I)
+
+#: unit-based direction for emit rows (takes precedence over names)
+_UNIT_HIGHER = re.compile(r"/s$|/sec$", re.I)
+_UNIT_LOWER = re.compile(r"^(ms|s|us|sec|seconds)$", re.I)
+
+
+def direction(name: str, unit: str = "") -> str:
+    """'higher' / 'lower' / 'both' — which way is worse."""
+    if unit:
+        if _UNIT_HIGHER.search(unit):
+            return "higher"
+        if _UNIT_LOWER.match(unit):
+            return "lower"
+    if _HIGHER_BETTER.search(name):
+        return "higher"
+    if _LOWER_BETTER.search(name):
+        return "lower"
+    return "both"
+
+
+def _flatten(obj, prefix=""):
+    """Nested dict -> {dotted key: leaf}; lists index numerically."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            out.update(_flatten(v, f"{prefix}{i}."))
+    else:
+        out[prefix[:-1]] = obj
+    return out
+
+
+def _informational(key: str) -> bool:
+    """Keys whose LAST path segment starts with '_' are excluded from
+    the gate (raw wall times, machine-specific context)."""
+    return any(seg.startswith("_") for seg in key.split("."))
+
+
+def load_side(path):
+    """Load one comparison side: returns ("rows", {metric: row}) for an
+    emit-row JSONL, ("object", dict) for a scenario JSON object."""
+    with open(path) as f:
+        text = f.read()
+    text = text.strip()
+    if not text:
+        raise ValueError(f"{path}: empty")
+    try:
+        body = json.loads(text)
+        if isinstance(body, dict):
+            return "object", body
+        if isinstance(body, list):
+            body_rows = body
+        else:
+            raise ValueError(f"{path}: not an object or row list")
+    except json.JSONDecodeError:
+        body_rows = [json.loads(line) for line in text.splitlines()
+                     if line.strip()]
+    rows = {}
+    for row in body_rows:
+        if isinstance(row, dict) and "metric" in row:
+            rows[str(row["metric"])] = row
+    if not rows:
+        raise ValueError(f"{path}: no emit rows with a 'metric' field")
+    return "rows", rows
+
+
+def _compare_value(key, new, base, tol, unit=""):
+    """One gate check; returns a failure dict or None."""
+    if isinstance(base, bool) or isinstance(new, bool):
+        if bool(new) != bool(base):
+            return {"key": key, "kind": "bool", "new": new, "base": base,
+                    "detail": "boolean contract flipped"}
+        return None
+    if not isinstance(base, (int, float)) or not isinstance(new, (int, float)):
+        return None  # strings/None are informational
+    if abs(base) < _NEAR_ZERO:
+        return None  # ratio against ~0 is meaningless
+    d = direction(key, unit)
+    ratio = (new - base) / abs(base)
+    if d == "higher" and ratio < -tol:
+        worse = True
+    elif d == "lower" and ratio > tol:
+        worse = True
+    elif d == "both" and abs(ratio) > tol:
+        worse = True
+    else:
+        worse = False
+    if not worse:
+        return None
+    return {"key": key, "kind": d, "new": new, "base": base,
+            "delta_pct": round(ratio * 100.0, 2), "tolerance_pct":
+            round(tol * 100.0, 2),
+            "detail": f"{key}: {base} -> {new} "
+                      f"({ratio * 100.0:+.1f}%, {d}-is-worse band "
+                      f"±{tol * 100.0:.0f}%)"}
+
+
+def diff(new_side, base_side, tolerance, per_metric=None):
+    """Compare two loaded sides; returns (checked, skipped, failures)."""
+    per_metric = per_metric or {}
+    failures, checked, skipped = [], 0, 0
+    kind_new, new = new_side
+    kind_base, base = base_side
+    if kind_new != kind_base:
+        raise ValueError(
+            f"cannot diff a {kind_new} file against a {kind_base} file")
+    if kind_new == "object":
+        flat_new = _flatten(new)
+        flat_base = _flatten(base)
+        for key in sorted(flat_base):
+            if _informational(key):
+                skipped += 1
+                continue
+            if key not in flat_new:
+                failures.append({"key": key, "kind": "missing",
+                                 "new": None, "base": flat_base[key],
+                                 "detail": f"{key}: missing from the "
+                                           "new result"})
+                continue
+            tol = per_metric.get(key, tolerance)
+            checked += 1
+            fail = _compare_value(key, flat_new[key], flat_base[key], tol)
+            if fail:
+                failures.append(fail)
+    else:
+        for metric in sorted(base):
+            brow = base[metric]
+            nrow = new.get(metric)
+            if nrow is None:
+                failures.append({"key": metric, "kind": "missing",
+                                 "new": None, "base": brow.get("value"),
+                                 "detail": f"{metric}: missing from the "
+                                           "new result"})
+                continue
+            tol = per_metric.get(metric, tolerance)
+            checked += 1
+            fail = _compare_value(metric, nrow.get("value"),
+                                  brow.get("value"), tol,
+                                  unit=str(brow.get("unit", "")))
+            if fail:
+                failures.append(fail)
+    return checked, skipped, failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("new", help="fresh BENCH_*.json / emit-row JSONL")
+    ap.add_argument("baseline", help="checked-in trajectory file")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative band, fraction (default 0.2 = ±20%%)")
+    ap.add_argument("--metric-tolerance", action="append", default=[],
+                    metavar="NAME=FRAC",
+                    help="per-metric override (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable verdict on stdout")
+    args = ap.parse_args(argv)
+
+    per_metric = {}
+    for spec in args.metric_tolerance:
+        if "=" not in spec:
+            print(f"bench_diff: bad --metric-tolerance {spec!r} "
+                  "(want NAME=FRAC)", file=sys.stderr)
+            return 2
+        name, frac = spec.rsplit("=", 1)
+        try:
+            per_metric[name] = float(frac)
+        except ValueError:
+            print(f"bench_diff: bad tolerance in {spec!r}",
+                  file=sys.stderr)
+            return 2
+
+    for path in (args.new, args.baseline):
+        if not os.path.exists(path):
+            print(f"bench_diff: no such file: {path}", file=sys.stderr)
+            return 2
+    try:
+        new_side = load_side(args.new)
+        base_side = load_side(args.baseline)
+        checked, skipped, failures = diff(new_side, base_side,
+                                          args.tolerance, per_metric)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+
+    verdict = {
+        "pass": not failures,
+        "checked": checked,
+        "skipped_informational": skipped,
+        "tolerance": args.tolerance,
+        "new": args.new,
+        "baseline": args.baseline,
+        "failures": failures,
+    }
+    if args.json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        print(f"bench_diff: {checked} metrics checked against "
+              f"{args.baseline} (±{args.tolerance * 100:.0f}% "
+              f"direction-aware; {skipped} informational skipped)")
+        for f in failures:
+            print(f"  REGRESSION {f['detail']}")
+        print("bench_diff: PASS" if not failures
+              else f"bench_diff: FAIL ({len(failures)} regression(s))")
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

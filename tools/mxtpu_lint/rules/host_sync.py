@@ -60,6 +60,15 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._admit"),
     ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._next_wake"),
     ("mxnet_tpu/serving/engine.py", "InferenceEngine._execute"),
+    # cluster observability plane: the federation publisher snapshots
+    # the registry off-thread and the watchdog loop reads already-
+    # emitted series — neither may add a dispatch or an unmarked sync
+    ("mxnet_tpu/observability/federation.py", "snapshot"),
+    ("mxnet_tpu/observability/federation.py", "_publish_once"),
+    ("mxnet_tpu/observability/federation.py", "_publisher_loop"),
+    ("mxnet_tpu/observability/watchdog.py", "poll"),
+    ("mxnet_tpu/observability/watchdog.py", "check_now"),
+    ("mxnet_tpu/observability/watchdog.py", "_watchdog_loop"),
 ]
 
 #: int()/float() args that are NEVER device syncs: static shape

@@ -65,6 +65,31 @@ def load_events(source):
     return events
 
 
+def load_source(source):
+    """Path/text -> (events, cluster). A plain JSONL trace yields
+    ``(events, None)``; a federation snapshot bundle (the JSON object
+    ``observability.federation.dump_cluster_snapshot()`` writes, marked
+    by its top-level ``federation`` key) yields the embedded trace
+    events plus the cluster body — so the existing per-process sections
+    AND the cluster sections render from the same file."""
+    import os
+
+    if "\n" not in source and os.path.exists(source):
+        with open(source) as f:
+            source = f.read()
+    text = source.strip()
+    if text.startswith("{"):
+        try:
+            body = json.loads(text)
+        except json.JSONDecodeError:
+            body = None
+        if isinstance(body, dict) and body.get("federation"):
+            events = [ev for ev in (body.get("events") or [])
+                      if isinstance(ev, dict) and "name" in ev]
+            return events, body
+    return load_events(source), None
+
+
 def aggregate(events, cat=None):
     """name -> [count, total_ms, min_ms, max_ms, bytes] over duration
     events. ``bytes`` sums the ``args.bytes`` payload some series carry
@@ -295,6 +320,91 @@ def render_steps(events):
     return "\n".join(lines)
 
 
+def render_cluster(cluster):
+    """'Cluster' section from a federation snapshot bundle: one row per
+    rank — step epoch, skew behind the front-runner, snapshot age at
+    bundle-generation time, series count, and the stale marker. Same
+    crash-proofing contract as every other section: no bundle / no
+    ranks -> empty string, malformed rank bodies render '-'."""
+    if not isinstance(cluster, dict):
+        return ""
+    ranks = cluster.get("ranks")
+    if not isinstance(ranks, dict) or not ranks:
+        return ""
+    stale = set()
+    for r in cluster.get("stale") or []:
+        try:
+            stale.add(int(r))
+        except (TypeError, ValueError):
+            pass
+    gen = cluster.get("generated_wall")
+    gen = float(gen) if isinstance(gen, (int, float)) else None
+
+    def rank_key(r):
+        try:
+            return (0, int(r))
+        except (TypeError, ValueError):
+            return (1, str(r))
+
+    rows, steps = [], []
+    for r in sorted(ranks, key=rank_key):
+        snap = ranks[r] if isinstance(ranks[r], dict) else {}
+        step = snap.get("step_epoch")
+        step = int(step) if isinstance(step, (int, float)) else None
+        if step is not None:
+            steps.append(step)
+        wall = snap.get("wall")
+        age = (gen - float(wall)
+               if gen is not None and isinstance(wall, (int, float))
+               else None)
+        rows.append((r, step, age, len(snap.get("metrics") or {})))
+    front = max(steps) if steps else None
+    lines = ["", "Cluster (federated snapshots):",
+             f"{'Rank':>6}{'Step':>10}{'Skew':>8}{'Age (s)':>10}"
+             f"{'Series':>9}  "]
+    for r, step, age, series in rows:
+        skew = (front - step
+                if front is not None and step is not None else None)
+        mark = "STALE" if rank_key(r)[1] in stale else ""
+        lines.append(
+            f"{str(r):>6}"
+            f"{(str(step) if step is not None else '-'):>10}"
+            f"{(str(skew) if skew is not None else '-'):>8}"
+            f"{(f'{age:.1f}' if age is not None else '-'):>10}"
+            f"{series:>9}  {mark}")
+    if stale:
+        lines.append(f"  stale ranks (> MXTPU_FEDERATION_STALE_S): "
+                     f"{sorted(stale)} — marked, last series still "
+                     f"exposed")
+    return "\n".join(lines)
+
+
+def render_anomalies(events):
+    """'Anomalies' section from the watchdog's ``anomaly`` trace
+    instants, aggregated by ``args.kind``. Crash-proof: absent series
+    -> empty string, malformed args aggregate under '-'."""
+    evs = [ev for ev in events if ev.get("name") == "anomaly"]
+    if not evs:
+        return ""
+    by_kind = {}
+    for ev in evs:
+        args = ev.get("args")
+        kind = str(args.get("kind", "-")) if isinstance(args, dict) \
+            else "-"
+        by_kind.setdefault(kind, []).append(ev)
+    lines = ["", "Anomalies (watchdog):"]
+    for kind in sorted(by_kind):
+        kevs = by_kind[kind]
+        largs = kevs[-1].get("args")
+        largs = largs if isinstance(largs, dict) else {}
+        detail = ", ".join(
+            f"{k}={largs[k]}" for k in sorted(largs)
+            if k not in ("kind",))[:120]
+        lines.append(f"  {kind}: {len(kevs)} firing(s)"
+                     + (f" — last: {detail}" if detail else ""))
+    return "\n".join(lines)
+
+
 def render_graph_contracts(root=None):
     """Static 'Graph contracts' section: what `mxtpu-lint --graph` is
     holding the tree to — pinned collective-order sites, the graph rule
@@ -357,7 +467,7 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     source = sys.stdin.read() if args.trace == "-" else args.trace
-    events = load_events(source)
+    events, cluster = load_source(source)
     print(render_table(events, cat=args.cat, sort_by=args.sort,
                        ascending=args.ascending))
     amp = render_amp(events)
@@ -372,6 +482,12 @@ def main(argv=None):
     serving = render_serving(events)
     if serving:
         print(serving)
+    cl = render_cluster(cluster)
+    if cl:
+        print(cl)
+    anomalies = render_anomalies(events)
+    if anomalies:
+        print(anomalies)
     gc = render_graph_contracts()
     if gc:
         print(gc)
