@@ -280,6 +280,12 @@ class Trainer:
             # actual sweep) — reads series already recorded above,
             # never adds a dispatch
             _obs.watchdog.poll()
+        # multi-process federation exchange at the step boundary: the
+        # side-channel collectives must interleave with the training
+        # allreduces in the same order on every rank, so they run HERE
+        # (same thread as pushpull, step-count beat) and never on the
+        # publisher timer thread; no-op unless armed + multi-process
+        _obs.federation.poll()
 
     def _step_impl(self, batch_size, ignore_stale_grad):
         if not self._kv_initialized:
@@ -1387,6 +1393,10 @@ class Superstep:
                 # the lazy loss/grad series above sync inside the
                 # watchdog, not here — zero added dispatches
                 _obs.watchdog.poll()
+            # step-beat federation exchange on the superstep thread —
+            # identically ordered vs the training collectives on every
+            # rank (no-op unless armed + multi-process)
+            _obs.federation.poll()
         mgr = getattr(tr, "_ckpt_manager", None)
         if mgr is not None:
             # one superstep = K training steps for checkpoint cadence

@@ -116,20 +116,26 @@ def _reduce_mesh():
         return _REDUCE["mesh"]
 
 
-def _global_allreduce(raw):
+def _global_allreduce(raw, chaos_point="collective"):
     """Sum an array across all JAX processes (no-op single-process).
 
     Lowered to one XLA AllReduce: the local array becomes this process's
     shard of a (num_processes, ...) global array partitioned on ``dp``;
     ``sum(axis=0)`` with a fully-replicated out-sharding is the reduce.
+
+    ``chaos_point=None`` exempts the call from chaos injection — the
+    federation side-channel uses it so a one-shot injected collective
+    fault armed for the training pushpull is never consumed by a
+    telemetry exchange instead (chaos certification stays
+    deterministic with ``MXTPU_FEDERATION=1``).
     """
     from ..resilience import chaos as _chaos
 
-    if _chaos.ENABLED:
+    if _chaos.ENABLED and chaos_point is not None:
         # one-shot injected collective failure (MXTPU_CHAOS=collective):
         # surfaces loudly from the pushpull — the regression hook for
         # "a dead collective fails, it does not hang"
-        _chaos.collective_point("collective")
+        _chaos.collective_point(chaos_point)
     if jax.process_count() == 1:
         return raw
     if _obs.ENABLED:
@@ -190,27 +196,43 @@ def all_gather_bytes(payload: bytes) -> list:
     rides the EXISTING collective plumbing — ``_global_allreduce`` with
     disjoint per-rank slots, where sum == gather — instead of growing a
     second transport next to the data plane. Two reduces: fixed-shape
-    lengths first, then the zero-padded payload matrix. Runs on the
-    federation publisher thread, never the training loop; the host
-    syncs below are the deliberate off-hot-path materialization.
+    lengths first, then the zero-padded payload matrix.
+
+    Ordering contract: collectives must enter the wire in the same
+    order on every rank, so callers may only invoke this from a point
+    ordered identically across the world — the step-boundary
+    ``federation.poll()`` hook (same thread as the pushpull) or a
+    synchronous test — NEVER from a free-running timer thread racing
+    the training loop's allreduces. Both reduces run under the same
+    ``MXTPU_BARRIER_TIMEOUT_S`` watchdog as the kvstore barrier: a
+    lost peer surfaces as CollectiveTimeoutError (the publisher's
+    degrade-to-local path) instead of blocking forever, and chaos
+    injection is skipped (the side-channel must not consume a one-shot
+    fault armed for the data plane). The host syncs below are the
+    deliberate off-hot-path materialization.
     """
     payload = bytes(payload)
     if jax.process_count() == 1:
         return [payload]
     n = jax.process_count()
     r = jax.process_index()
+    timeout = _barrier_timeout_s()
 
     ln = _np.zeros((n,), dtype=_np.int32)
     ln[r] = len(payload)
     lengths = _np.asarray(  # mxtpu-lint: host-sync-ok
-        _global_allreduce(jnp.asarray(ln)))
+        _call_with_timeout(
+            lambda: _global_allreduce(jnp.asarray(ln), chaos_point=None),
+            timeout, "federation all_gather (lengths)"))
     maxlen = int(lengths.max())
 
     buf = _np.zeros((n, max(maxlen, 1)), dtype=_np.uint8)
     if payload:
         buf[r, : len(payload)] = _np.frombuffer(payload, dtype=_np.uint8)
     gathered = _np.asarray(  # mxtpu-lint: host-sync-ok
-        _global_allreduce(jnp.asarray(buf)))
+        _call_with_timeout(
+            lambda: _global_allreduce(jnp.asarray(buf), chaos_point=None),
+            timeout, "federation all_gather (payload)"))
     # jnp.sum promotes uint8 — cast back before slicing out the blobs
     gathered = gathered.astype(_np.uint8)
     return [gathered[i, : int(lengths[i])].tobytes() for i in range(n)]

@@ -427,11 +427,11 @@ SUPERSTEP_ITER_OVERFLOW = _REGISTRY.series_gauge(
 
 FEDERATION_PUBLISH_TOTAL = _REGISTRY.counter(
     "mxtpu_federation_publish_total",
-    "registry snapshots this rank published onto the kvstore "
-    "side-channel (the federation publisher heartbeat)")
+    "registry snapshot publishes by this rank: local heartbeat beats "
+    "plus successful step-beat cross-rank exchanges")
 FEDERATION_ERRORS_TOTAL = _REGISTRY.counter(
     "mxtpu_federation_errors_total",
-    "failed federation exchanges (the publisher degraded to a "
+    "failed federation exchanges (the step-beat poll degraded to a "
     "local-only publish; the cluster view goes stale, never dark)")
 FEDERATION_RANKS = _REGISTRY.gauge(
     "mxtpu_federation_ranks",

@@ -21,15 +21,31 @@ module turns the per-process registries into ONE cluster picture:
   ``tools/telemetry_report.py`` can line the same step up across ranks
   (the cross-rank straggler/skew picture).
 
+Collective-ordering contract: cross-process collectives must enter
+the wire in the SAME order on every rank, and each rank's publisher
+timer fires on an independent clock — so the daemon thread NEVER
+issues collectives. It only refreshes this rank's local row + the
+meta gauges. The multi-process ``exchange()`` is driven exclusively
+from ``poll()``, the step-boundary hook the trainer calls on the same
+thread as the pushpull (like ``watchdog.poll``): it fires on a
+step-count beat (``MXTPU_FEDERATION_BEAT_STEPS``) derived from the
+shared tracer step, and synchronous data-parallel ranks execute
+identical step sequences, so every rank enters the gather between the
+same two training allreduces.
+
 Hot-path contract (pinned by the dispatch-count regression test): the
-training loop NEVER blocks on federation. Snapshots are taken on the
-publisher daemon thread (or an HTTP handler thread); lazy device
-scalars stored by ``Gauge.set_lazy`` float exactly there — zero added
-dispatches, zero added syncs per step.
+training loop NEVER blocks on per-step federation work. Snapshots are
+taken on the publisher daemon thread (or an HTTP handler thread);
+lazy device scalars stored by ``Gauge.set_lazy`` float exactly there
+— zero added dispatches, zero added syncs per step. In a multi-process
+world the beat-step exchange is the one deliberate exception: two
+watchdog-timed collectives every ``MXTPU_FEDERATION_BEAT_STEPS``
+steps, amortized off the steady-state step cost.
 
 Switch: ``MXTPU_FEDERATION=1`` arms the background publisher
-(interval ``MXTPU_FEDERATION_INTERVAL_S``); ``exchange()`` /
-``publish_local()`` work without it for deterministic tests.
+(interval ``MXTPU_FEDERATION_INTERVAL_S``) and the step-beat poll;
+``exchange()`` / ``publish_local()`` work without it for
+deterministic tests.
 """
 
 from __future__ import annotations
@@ -51,11 +67,19 @@ _CLUSTER_LOCK = threading.Lock()
 _PUBLISHER = {"thread": None, "stop": None}
 _PUB_LOCK = threading.Lock()
 
+#: step-beat state for the trainer-driven exchange: armed by start(),
+#: consumed by poll() on the trainer thread. ``last_idx`` is the last
+#: beat index (tracer step // MXTPU_FEDERATION_BEAT_STEPS) exchanged —
+#: pure step arithmetic, identical on every rank by construction.
+_BEAT = {"active": False, "last_idx": -1}
+
 #: machine-checked lock protocol (mxtpu-lint thread-guard): the cluster
 #: table is written by the publisher/HTTP threads and read by the
-#: exposition path concurrently; the publisher singleton mutates only
-#: under its lock so start/stop cannot leak a second daemon thread
-_GUARDED_BY = {"_CLUSTER": "_CLUSTER_LOCK", "_PUBLISHER": "_PUB_LOCK"}
+#: exposition path concurrently; the publisher singleton and the beat
+#: state mutate only under the publisher lock so start/stop cannot
+#: leak a second daemon thread or a stale beat counter
+_GUARDED_BY = {"_CLUSTER": "_CLUSTER_LOCK", "_PUBLISHER": "_PUB_LOCK",
+               "_BEAT": "_PUB_LOCK"}
 
 
 def federation_enabled() -> bool:
@@ -73,6 +97,15 @@ def federation_stale_s() -> float:
     """``MXTPU_FEDERATION_STALE_S`` (default 30): snapshot age beyond
     which a rank is marked stale (0 disables marking)."""
     return float(getenv("MXTPU_FEDERATION_STALE_S", 30.0, dtype=float))
+
+
+def federation_beat_steps() -> int:
+    """``MXTPU_FEDERATION_BEAT_STEPS`` (default 32): trainer steps
+    between multi-process exchanges. A step count, not seconds — the
+    beat must be derived from state every rank advances identically
+    (the shared step counter), never a per-rank wall clock."""
+    return max(1, int(getenv("MXTPU_FEDERATION_BEAT_STEPS", 32,
+                             dtype=int)))
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +187,15 @@ def _process_index() -> int:
         return 0
 
 
+def _world_size() -> int:
+    try:
+        import jax
+
+        return int(jax.process_count())  # mxtpu-lint: host-sync-ok
+    except Exception:
+        return 1
+
+
 def ingest(snap: dict, recv_mono=None):
     """Record one rank's snapshot into the cluster table (the seam the
     exchange path, tests and bench synthetic ranks all feed)."""
@@ -174,9 +216,16 @@ def publish_local():
 
 def exchange():
     """All-gather every rank's snapshot over the kvstore side-channel
-    and ingest them all. Raises on collective failure (the publisher
-    loop catches and degrades to ``publish_local``; a dist test lets
-    the platform error surface so the launcher skip-contract applies).
+    and ingest them all. Raises on collective failure (the step-beat
+    ``poll()`` catches and degrades to ``publish_local``; a dist test
+    lets the platform error surface so the launcher skip-contract
+    applies).
+
+    Call ONLY from a point ordered identically on every rank — the
+    step-boundary ``poll()`` or a synchronous test — never from a
+    free-running thread: the two side-channel collectives must
+    interleave with the training allreduces in the same order on
+    every process (see ``all_gather_bytes``).
     """
     snap = snapshot()
     payload = json.dumps(snap, default=float).encode("utf-8")
@@ -397,22 +446,28 @@ def dump_cluster_snapshot(path=None) -> str:
 # ---------------------------------------------------------------------------
 
 def _publish_once():  # mxtpu-lint: hot-path
-    """One publisher beat: multi-process worlds exchange over the
-    collective channel; failures degrade to a local publish (counted,
-    logged) so the scrape endpoint never goes dark."""
+    """One publisher heartbeat: refresh OUR row + the meta gauges.
+
+    LOCAL ONLY — this runs on the daemon timer thread, whose clock is
+    independent per rank, so it must never issue collectives: a
+    federation gather launched here can interleave differently with
+    the training loop's allreduces on different ranks (mismatched
+    cross-process collective order deadlocks or corrupts results).
+    The multi-process exchange lives in ``poll()``."""
+    from . import FEDERATION_PUBLISH_TOTAL
+
+    publish_local()
+    FEDERATION_PUBLISH_TOTAL.inc()
+    update_cluster_meta()
+
+
+def _exchange_once():  # mxtpu-lint: hot-path
+    """One step-beat exchange: failures degrade to a local publish
+    (counted, logged) so the scrape endpoint never goes dark."""
     from . import FEDERATION_ERRORS_TOTAL, FEDERATION_PUBLISH_TOTAL
 
     try:
-        import jax
-
-        nproc = int(jax.process_count())  # mxtpu-lint: host-sync-ok
-    except Exception:
-        nproc = 1
-    try:
-        if nproc > 1:
-            exchange()
-        else:
-            publish_local()
+        exchange()
         FEDERATION_PUBLISH_TOTAL.inc()
     except Exception as e:
         FEDERATION_ERRORS_TOTAL.inc()
@@ -425,13 +480,41 @@ def _publish_once():  # mxtpu-lint: hot-path
     update_cluster_meta()
 
 
+def poll():  # mxtpu-lint: hot-path
+    """Trainer-cadence hook (the step thread, right after pushpull):
+    the ONLY place a multi-process federation exchange runs.
+
+    Fires on a step-count beat (``MXTPU_FEDERATION_BEAT_STEPS``)
+    derived from the shared tracer step: synchronous data-parallel
+    ranks execute identical step sequences, so every rank reaches the
+    same beat between the same two training allreduces — the
+    side-channel collectives stay identically ordered across the
+    world, which a per-rank interval timer cannot guarantee.
+    Single-process worlds are fully covered by the daemon heartbeat;
+    there poll() is a no-op (the zero-added-dispatch contract)."""
+    if not _BEAT["active"]:
+        return False
+    if _world_size() <= 1:
+        return False
+    from . import _TRACER
+
+    idx = _TRACER.step // federation_beat_steps()
+    with _PUB_LOCK:
+        if idx <= _BEAT["last_idx"]:
+            return False
+        _BEAT["last_idx"] = idx
+    _exchange_once()
+    return True
+
+
 def _publisher_loop(stop, interval):  # mxtpu-lint: hot-path
     while not stop.wait(interval):
         _publish_once()
 
 
 def start(interval=None) -> bool:
-    """Start the publisher daemon thread (idempotent)."""
+    """Start the publisher daemon thread and arm the step-beat poll
+    (idempotent)."""
     if interval is None:
         interval = federation_interval_s()
     with _PUB_LOCK:
@@ -443,15 +526,18 @@ def start(interval=None) -> bool:
             target=_publisher_loop, args=(stop_ev, float(interval)),
             name="mxtpu-federation", daemon=True)
         _PUBLISHER.update(thread=t, stop=stop_ev)
+        _BEAT.update(active=True, last_idx=-1)
         t.start()
     return True
 
 
 def stop():
-    """Stop the publisher thread (idempotent); join outside the lock."""
+    """Stop the publisher thread and disarm the step-beat poll
+    (idempotent); join outside the lock."""
     with _PUB_LOCK:
         t, ev = _PUBLISHER["thread"], _PUBLISHER["stop"]
         _PUBLISHER.update(thread=None, stop=None)
+        _BEAT.update(active=False, last_idx=-1)
     if ev is not None:
         ev.set()
     if t is not None:

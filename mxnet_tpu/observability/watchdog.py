@@ -94,7 +94,11 @@ def set_enabled(on: bool) -> bool:
 
 
 def reset():
-    """Restore pristine detector state (test isolation)."""
+    """Restore pristine detector state AND wiring (test isolation):
+    a stale CheckpointManager from a previous trainer must not keep
+    receiving proactive saves, and the flight-note flag re-arms so a
+    fresh flight module can be registered against (re-registration of
+    the same hook is idempotent in ``flight.register_pre_dump``)."""
     with _LOCK:
         _STATE["loss_window"].clear()
         _STATE["grad_window"].clear()
@@ -106,6 +110,8 @@ def reset():
         _STATE["queue_latched"] = set()
         _STATE["last_poll"] = 0.0
         _STATE["anomalies"].clear()
+        _STATE["ckpt_mgr"] = None
+        _STATE["note_registered"] = False
 
 
 def attach_checkpoint_manager(mgr):

@@ -338,3 +338,30 @@ def test_graphcheck_harness_covers_canonical_sites():
     sites = json.loads(line[0][len("SITES="):])
     missing = missing_canonical(sites)
     assert missing == [], (missing, sites)
+
+
+def test_bench_diff_direction_classification():
+    """The bench gate's direction map must read count metrics as
+    lower-is-better: an unanchored 'per_s' token substring-matched
+    '_per_step' names, inverting the gate for dispatch counters (a
+    +20% dispatch regression passed, an improvement failed)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff", os.path.join(ROOT, "tools", "bench_diff.py"))
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+
+    # dispatch counts: MORE dispatches is worse
+    assert bd.direction("dispatches_per_step") == "lower"
+    assert bd.direction("dispatches_per_step_superstep") == "lower"
+    # rate metrics keep higher-is-better (anchored per_s / per_sec)
+    assert bd.direction("steps_per_sec_federated") == "higher"
+    assert bd.direction("images_per_s") == "higher"
+    assert bd.direction("train_throughput") == "higher"
+    # latency stays lower-is-better; unknown names stay symmetric
+    assert bd.direction("step_time_p99_ms") == "lower"
+    assert bd.direction("some_novel_metric") == "both"
+    # unit classification still takes precedence over the name
+    assert bd.direction("weird_name", unit="img/s") == "higher"
+    assert bd.direction("weird_name", unit="ms") == "lower"

@@ -287,6 +287,105 @@ def test_dump_cluster_snapshot_renders_in_report(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# step-beat poll: cross-rank collective-ordering discipline
+# ---------------------------------------------------------------------------
+
+def test_poll_noop_when_unarmed_or_single_process():
+    assert fed.poll() is False        # not armed
+    fed.start(interval=60.0)
+    try:
+        assert fed.poll() is False    # armed, but single-process
+    finally:
+        fed.stop()
+
+
+def test_poll_exchanges_on_step_beat(monkeypatch):
+    """The multi-process exchange fires ONLY from the step-boundary
+    poll, on a step-count beat derived from the shared tracer step —
+    identical on every rank, so the side-channel collectives stay
+    identically ordered against the training allreduces."""
+    calls = []
+    monkeypatch.setenv("MXTPU_FEDERATION_BEAT_STEPS", "4")
+    monkeypatch.setattr(fed, "_world_size", lambda: 2)
+    monkeypatch.setattr(fed, "exchange",
+                        lambda: calls.append(obs.tracer().step))
+    fed.start(interval=60.0)
+    try:
+        for _ in range(9):
+            obs.tracer().mark_step()
+            fed.poll()
+    finally:
+        fed.stop()
+    # beat indices 0/1/2 -> first poll at steps 1, 4 and 8; the polls
+    # in between are pure host-side compares (no exchange)
+    assert calls == [1, 4, 8]
+    assert obs.FEDERATION_PUBLISH_TOTAL.total() >= 3
+
+
+def test_poll_degrades_to_local_on_exchange_failure(monkeypatch):
+    """A failed exchange is COUNTED and degrades to a local publish —
+    the scrape endpoint never goes dark, and the error signal the
+    federation contract promises actually fires."""
+    monkeypatch.setattr(fed, "_world_size", lambda: 2)
+
+    def boom():
+        raise RuntimeError("collective down")
+
+    monkeypatch.setattr(fed, "exchange", boom)
+    fed.start(interval=60.0)
+    try:
+        obs.tracer().mark_step()
+        assert fed.poll() is True
+    finally:
+        fed.stop()
+    assert obs.FEDERATION_ERRORS_TOTAL.total() == 1
+    assert fed.cluster_ranks() == [0]   # local publish still landed
+
+
+def test_publisher_thread_never_issues_collectives(monkeypatch):
+    """The heartbeat daemon stays LOCAL-ONLY even in a multi-process
+    world: its timer fires on an independent clock per rank, so a
+    collective launched from it would interleave differently with the
+    training loop's allreduces on different processes."""
+    monkeypatch.setattr(fed, "_world_size", lambda: 2)
+
+    def forbidden():
+        raise AssertionError("exchange() ran on the timer thread")
+
+    monkeypatch.setattr(fed, "exchange", forbidden)
+    fed.start(interval=0.02)
+    try:
+        deadline = time.monotonic() + 5.0
+        while obs.FEDERATION_PUBLISH_TOTAL.total() < 3:
+            assert time.monotonic() < deadline, "publisher never ticked"
+            time.sleep(0.01)
+    finally:
+        fed.stop()
+    assert obs.FEDERATION_ERRORS_TOTAL.total() == 0
+    assert fed.cluster_ranks() == [0]
+
+
+def test_side_channel_collectives_exempt_from_chaos():
+    """A one-shot MXTPU_CHAOS collective fault armed for the data
+    plane must never be consumed by a federation side-channel reduce
+    (chaos certification stays deterministic with MXTPU_FEDERATION=1)."""
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kvstore import dist as kvd
+    from mxnet_tpu.resilience import chaos
+    from mxnet_tpu.resilience.chaos import ChaosInjectedError
+
+    chaos.configure("collective:1")
+    try:
+        arr = jnp.ones((2,), dtype=jnp.float32)
+        kvd._global_allreduce(arr, chaos_point=None)   # exempt: no fire
+        with pytest.raises(ChaosInjectedError):
+            kvd._global_allreduce(arr)                 # data plane fires
+    finally:
+        chaos.reset()
+
+
+# ---------------------------------------------------------------------------
 # publisher thread + the zero-dispatch contract
 # ---------------------------------------------------------------------------
 

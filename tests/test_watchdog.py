@@ -183,6 +183,22 @@ def test_proactive_checkpoint_opt_in(monkeypatch):
     assert mgr.calls == ["anomaly"]
 
 
+def test_reset_clears_checkpoint_wiring(monkeypatch):
+    """reset() restores WIRING too: a stale CheckpointManager from a
+    previous trainer must not keep receiving proactive saves, and the
+    flight-note flag re-arms for a fresh registration."""
+    mgr = _FakeMgr()
+    wd.attach_checkpoint_manager(mgr)
+    wd.reset()
+    assert wd._STATE["ckpt_mgr"] is None
+    assert wd._STATE["note_registered"] is False
+    monkeypatch.setenv("MXTPU_WATCHDOG_CHECKPOINT", "1")
+    obs.SUPERSTEP_ITER_LOSS.set_series([float("nan")])
+    _mark()
+    assert "nan" in wd.check_now()
+    assert mgr.calls == []  # the detached manager saw nothing
+
+
 def test_real_checkpoint_manager_attach_wires_watchdog(tmp_path,
                                                        monkeypatch):
     """CheckpointManager.attach hands itself to the armed watchdog; a

@@ -44,13 +44,18 @@ _NEAR_ZERO = 1e-9
 
 #: direction classification by metric/field name (checked in order:
 #: higher-better first, so "throughput_ms" style collisions resolve to
-#: the more specific throughput intent last via the unit instead)
+#: the more specific throughput intent last via the unit instead).
+#: the per_s token is anchored to a path-segment boundary: it must
+#: only match rate units ("steps_per_s", "imgs_per_sec"), never count
+#: names like "dispatches_per_step" — an unanchored substring match
+#: inverted the gate for dispatch counters (more dispatches read as
+#: "better", and a regression passed while an improvement failed)
 _HIGHER_BETTER = re.compile(
-    r"(throughput|per_s|_qps|qps_|speedup|reduction|recovered|hidden"
-    r"|fraction|_mfu|mfu_|fill|ranks|ok$|_ok_)", re.I)
+    r"(throughput|(^|_)per_s(ec)?(_|$)|_qps|qps_|speedup|reduction"
+    r"|recovered|hidden|fraction|_mfu|mfu_|fill|ranks|ok$|_ok_)", re.I)
 _LOWER_BETTER = re.compile(
     r"(_ms|_s$|_us|seconds|latency|overhead|_time|time_|p50|p99|p999"
-    r"|lost|miss|stale|errors|skew|wait|age|exposed)", re.I)
+    r"|lost|miss|stale|errors|skew|wait|age|exposed|dispatch)", re.I)
 
 #: unit-based direction for emit rows (takes precedence over names)
 _UNIT_HIGHER = re.compile(r"/s$|/sec$", re.I)

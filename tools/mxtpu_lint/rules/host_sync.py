@@ -65,6 +65,8 @@ HOT_FUNCTIONS = [
     # emitted series — neither may add a dispatch or an unmarked sync
     ("mxnet_tpu/observability/federation.py", "snapshot"),
     ("mxnet_tpu/observability/federation.py", "_publish_once"),
+    ("mxnet_tpu/observability/federation.py", "_exchange_once"),
+    ("mxnet_tpu/observability/federation.py", "poll"),
     ("mxnet_tpu/observability/federation.py", "_publisher_loop"),
     ("mxnet_tpu/observability/watchdog.py", "poll"),
     ("mxnet_tpu/observability/watchdog.py", "check_now"),
