@@ -87,6 +87,29 @@ def _emit(metric, value, unit, vs_baseline=None, **extra):
         print(line, flush=True)
 
 
+def _phase_fields(site=None, last_n=None):
+    """Attribution-plane stamps for a just-timed loop: (row extras with
+    ``phase_*_ms`` + ``phase_sum_ms``, the ``_phases`` block for the
+    scenario JSON). Both empty when the plane recorded nothing (plane
+    dark, or the scenario never armed telemetry) so stamping degrades
+    to absent fields instead of zeros."""
+    from mxnet_tpu import observability as obs
+
+    mean = obs.attribution.mean_phases(site=site, last_n=last_n)
+    if not mean:
+        return {}, None
+    row, block, total = {}, {}, 0.0
+    for ph in obs.attribution.PHASES:
+        ms = mean[ph] * 1e3
+        total += ms
+        row[f"phase_{ph}_ms"] = round(ms, 4)
+        block[f"{ph}_ms"] = round(ms, 4)
+    row["phase_sum_ms"] = round(total, 4)
+    block["step_wall_ms"] = round(mean["step_wall"] * 1e3, 4)
+    block["steps"] = int(mean["count"])
+    return row, block
+
+
 def bench_resnet(backend):
     import numpy as np
 
@@ -399,6 +422,9 @@ def bench_train_step(backend):
 
     def run(fused):
         prev = fusedstep.set_enabled(fused)
+        # telemetry armed for BOTH legs (identical overhead, superstep
+        # posture) so the attribution plane decomposes each timed step
+        prev_obs = obs.set_enabled(True)
         # XLA cost analysis on the fused leg's executables (fwd/bwd/
         # update): where the row's flops_per_step/mfu stamp comes from
         prev_intro = obs.introspect.set_enabled(True) if fused else None
@@ -429,15 +455,20 @@ def bench_train_step(backend):
             for _ in range(steps):
                 l = one()
             engine.wait(l.data)
-            return steps / (time.perf_counter() - t0)
+            sps = steps / (time.perf_counter() - t0)
+            # per-phase decomposition of the timed loop (last_n skips
+            # the warmup records still in the attribution ring)
+            ph_row, ph_block = _phase_fields(site="trainer", last_n=steps)
+            return sps, ph_row, ph_block
         finally:
             fusedstep.set_enabled(prev)
+            obs.set_enabled(prev_obs)
             if prev_intro is not None:
                 obs.introspect.set_enabled(prev_intro)
 
     obs.introspect.reset()  # this scenario's sites only
-    eager_sps = run(False)
-    fused_sps = run(True)
+    eager_sps, eager_ph, eager_block = run(False)
+    fused_sps, fused_ph, fused_block = run(True)
     fps, fps_reason = obs.introspect.flops_per_step()
     peak = _peak_tflops()
     tflops = fps * fused_sps / 1e12 if fps else None
@@ -446,13 +477,13 @@ def bench_train_step(backend):
     _emit(f"train_step_eager_{tag}", eager_sps, "steps/sec", None,
           step_ms=1e3 / eager_sps, steps=steps,
           flops_per_step=fps, mfu=None,
-          mfu_reason=fps_reason or _mfu_null_reason())
+          mfu_reason=fps_reason or _mfu_null_reason(), **eager_ph)
     _emit(f"train_step_fused_{tag}", fused_sps, "steps/sec", None,
           step_ms=1e3 / fused_sps, steps=steps,
           speedup_vs_eager=round(fused_sps / eager_sps, 3),
           flops_per_step=fps, tflops=tflops, mfu=mfu,
           mfu_reason=None if mfu is not None
-          else (fps_reason or _mfu_null_reason()))
+          else (fps_reason or _mfu_null_reason()), **fused_ph)
     out_path = os.environ.get(
         "BENCH_PR3_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -466,7 +497,11 @@ def bench_train_step(backend):
                    "fused_speedup": round(fused_sps / eager_sps, 3),
                    "flops_per_step": fps, "mfu": mfu,
                    "mfu_reason": None if mfu is not None
-                   else (fps_reason or _mfu_null_reason())}, f,
+                   else (fps_reason or _mfu_null_reason()),
+                   # "_"-prefixed => informational for bench_diff; the
+                   # doctor's --diff reads these to say WHICH phase moved
+                   "_phases": {"eager": eager_block,
+                               "fused": fused_block}}, f,
                   indent=2)
         f.write("\n")
 
@@ -545,6 +580,7 @@ def bench_superstep(backend):
         engine.wait(l.data)
         k1_sps = steps / (time.perf_counter() - t0)
         d_k1 = (dispatches() - c0) / steps
+        k1_ph, k1_block = _phase_fields(site="trainer", last_n=steps)
 
         # K=k: whole-program superstep, one dispatch per K steps
         net2, tr2 = build()
@@ -559,6 +595,8 @@ def bench_superstep(backend):
         engine.wait(l.data)
         ss_sps = steps / (time.perf_counter() - t0)
         d_kk = (dispatches() - c0) / steps
+        ss_ph, ss_block = _phase_fields(site="superstep",
+                                        last_n=steps // k)
     finally:
         obs.set_enabled(prev_obs)
         obs.introspect.set_enabled(prev_intro)
@@ -581,14 +619,14 @@ def bench_superstep(backend):
           step_ms=1e3 / k1_sps, steps=steps,
           dispatches_per_step=round(d_k1, 3),
           flops_per_step=fps_k1, mfu=_mfu(fps_k1, k1_sps),
-          mfu_reason=r_k1)
+          mfu_reason=r_k1, **k1_ph)
     _emit(f"train_step_superstep_k{k}_{tag}", ss_sps, "steps/sec", None,
           step_ms=1e3 / ss_sps, steps=steps,
           speedup_vs_k1=round(ss_sps / k1_sps, 3),
           dispatches_per_step=round(d_kk, 3),
           dispatch_reduction=round(reduction, 1),
           flops_per_step=fps_ss, mfu=_mfu(fps_ss, ss_sps),
-          mfu_reason=r_ss)
+          mfu_reason=r_ss, **ss_ph)
     out_path = os.environ.get(
         "BENCH_PR6_OUT",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -606,7 +644,9 @@ def bench_superstep(backend):
                    "flops_per_step": fps_ss,
                    "mfu": _mfu(fps_ss, ss_sps),
                    "mfu_reason": r_ss or (None if peak else
-                                          _mfu_null_reason())}, f,
+                                          _mfu_null_reason()),
+                   "_phases": {"k1": k1_block,
+                               "superstep": ss_block}}, f,
                   indent=2)
         f.write("\n")
 
@@ -1168,33 +1208,42 @@ def bench_serving(backend):
     lengths = rng.choice([3, 5, 8, 11, 16, 21, 27, 32], size=n_reqs)
     rows = [rng.rand(int(t), feat).astype(np.float32) for t in lengths]
 
-    # leg (a): continuous batching under a burst of async submits
-    eng = InferenceEngine(build(), buckets, max_batch=max_batch,
-                          max_wait_ms=wait_ms, queue_cap=n_reqs + 8,
-                          name="bench")
-    compiles_sealed = eng.stats()["compiles"]
-    for r in rows[:4]:
-        eng.predict(r, timeout=120.0)  # traffic warmup
-    t0 = time.perf_counter()
-    futs = [eng.submit(r) for r in rows]
-    for f in futs:
-        f.result(timeout=300.0)
-    batched_qps = n_reqs / (time.perf_counter() - t0)
-    st = eng.stats()
-    recompiles = st["compiles"] - compiles_sealed
-    eng.close()
+    # telemetry armed for both legs: serving.request phase spans land
+    # in the trace ring, so BENCH_telemetry.jsonl feeds mxtpu_doctor a
+    # serving verdict (the tier-1 bench smoke asserts it renders)
+    from mxnet_tpu import observability as obs
 
-    # leg (b): single-request baseline — no batching window, serial
-    eng1 = InferenceEngine(build(), buckets, max_batch=max_batch,
-                           max_wait_ms=0.0, queue_cap=64,
-                           name="bench_single")
-    for r in rows[:2]:
-        eng1.predict(r, timeout=120.0)
-    t0 = time.perf_counter()
-    for r in rows[:n_single]:
-        eng1.predict(r, timeout=120.0)
-    single_qps = n_single / (time.perf_counter() - t0)
-    eng1.close()
+    prev_obs = obs.set_enabled(True)
+    try:
+        # leg (a): continuous batching under a burst of async submits
+        eng = InferenceEngine(build(), buckets, max_batch=max_batch,
+                              max_wait_ms=wait_ms, queue_cap=n_reqs + 8,
+                              name="bench")
+        compiles_sealed = eng.stats()["compiles"]
+        for r in rows[:4]:
+            eng.predict(r, timeout=120.0)  # traffic warmup
+        t0 = time.perf_counter()
+        futs = [eng.submit(r) for r in rows]
+        for f in futs:
+            f.result(timeout=300.0)
+        batched_qps = n_reqs / (time.perf_counter() - t0)
+        st = eng.stats()
+        recompiles = st["compiles"] - compiles_sealed
+        eng.close()
+
+        # leg (b): single-request baseline — no batching window, serial
+        eng1 = InferenceEngine(build(), buckets, max_batch=max_batch,
+                               max_wait_ms=0.0, queue_cap=64,
+                               name="bench_single")
+        for r in rows[:2]:
+            eng1.predict(r, timeout=120.0)
+        t0 = time.perf_counter()
+        for r in rows[:n_single]:
+            eng1.predict(r, timeout=120.0)
+        single_qps = n_single / (time.perf_counter() - t0)
+        eng1.close()
+    finally:
+        obs.set_enabled(prev_obs)
 
     first = _bench_serve_cold_warm()
     speedup = batched_qps / single_qps if single_qps else None

@@ -218,8 +218,15 @@ class DevicePrefetcher:
         t0 = time.perf_counter()
         kind, payload = self._queue.get()
         if _obs.ENABLED:
-            _obs.DATA_PREFETCH_WAIT_SECONDS.inc(time.perf_counter() - t0)
+            wait = time.perf_counter() - t0
+            _obs.DATA_PREFETCH_WAIT_SECONDS.inc(wait)
             _obs.DATA_PREFETCH_QUEUE_DEPTH.set(self._queue.qsize())
+            if _obs.attribution.ENABLED:
+                # spike evidence for the attribution plane: the longest
+                # SINGLE wait inside the step period (a dict compare +
+                # rare store — the running total above stays the source
+                # of the per-step input_wait delta)
+                _obs.attribution.note_input_wait(wait)
         if kind == "ok":
             gen, batch = payload
             if gen != self._placement_gen:

@@ -195,6 +195,12 @@ DATA_H2D_SECONDS = _REGISTRY.histogram(
     "mxtpu_data_h2d_seconds",
     "host->device staging latency per batch (convert + device_put "
     "dispatch; async backends may finish the copy later)")
+DATA_PREFETCH_WAIT_DELTA = _REGISTRY.gauge(
+    "mxtpu_data_prefetch_wait_delta_seconds",
+    "consumer prefetch-queue wait attributed to the LAST step (the "
+    "per-step delta of the _total counter, set by the attribution "
+    "plane) — an input-wait spike is visible here where the running "
+    "total hides it; the watchdog's input_wait detector reads this")
 
 COMPILE_CACHE_HITS = _REGISTRY.counter(
     "mxtpu_compile_cache_hit_total",
@@ -222,6 +228,23 @@ SUPERSTEP_STEP_SECONDS = _REGISTRY.histogram(
     "time the host observes (gauges update once per superstep, so "
     "per-step series have K-step cadence; docs/observability.md)")
 
+# -- step-time attribution plane (observability/attribution.py) ------------
+
+STEP_PHASE_SECONDS = _REGISTRY.histogram(
+    "mxtpu_step_phase_seconds",
+    "per-step wall time by phase (input_wait / h2d / ckpt_overhead / "
+    "comm_exposed / compute / host_gap) from the attribution plane's "
+    "budget decomposition of each step period — phases are >= 0 and "
+    "sum to the period by construction; superstep dispatches are "
+    "amortized over their K (docs/observability.md, 'Reading an "
+    "attribution report')")
+STEP_PHASE_LAST = _REGISTRY.series_gauge(
+    "mxtpu_step_phase_last_seconds",
+    "the last-N per-step phase records, by phase — stored as a LAZY "
+    "view over the attribution ring (materializes at read/exposition "
+    "time, zero per-step list building); slot 0 is the oldest retained "
+    "step")
+
 # -- scale-out: overlapped allreduce + ZeRO sharding (parallel/) ----------
 
 OVERLAP_BUCKETS = _REGISTRY.gauge(
@@ -248,11 +271,16 @@ ZERO_STATE_BYTES = _REGISTRY.gauge(
 
 def record_overlap_probe(exposed_by_mode, hidden_fraction):
     """Publish an overlap measurement (exposed comm seconds per mode +
-    the hidden fraction) into the registry."""
+    the hidden fraction) into the registry, and hand the per-mode
+    exposed figures to the attribution plane as its comm hint (in-graph
+    comm schedules leave no host timestamp to delta)."""
     for mode, sec in (exposed_by_mode or {}).items():
         OVERLAP_EXPOSED_COMM_SECONDS.set(float(sec), mode=str(mode))
     if hidden_fraction is not None:
         OVERLAP_HIDDEN_FRACTION.set(float(hidden_fraction))
+    from . import attribution as _attr  # late: submodule binds at bottom
+
+    _attr.set_comm_hint(exposed_by_mode)
 
 
 AMP_LOSS_SCALE = _REGISTRY.gauge(
@@ -275,6 +303,12 @@ CHECKPOINT_SECONDS = _REGISTRY.histogram(
     "mxtpu_checkpoint_seconds",
     "wall time of one checkpoint serialize+write+commit (runs on the "
     "background writer thread — NOT training-loop time)")
+CHECKPOINT_TICK_SECONDS = _REGISTRY.counter(
+    "mxtpu_checkpoint_tick_seconds_total",
+    "training-LOOP time spent entering checkpoints (interval bookkeeping "
+    "+ snapshot dispatch + writer-queue handoff) — the in-loop cost the "
+    "attribution plane charges to ckpt_overhead; the background write "
+    "itself stays in mxtpu_checkpoint_seconds")
 CHECKPOINT_BYTES_TOTAL = _REGISTRY.counter(
     "mxtpu_checkpoint_bytes_total",
     "payload bytes committed to checkpoint storage")
@@ -455,8 +489,8 @@ FEDERATION_LAST_STEP = _REGISTRY.gauge(
 ANOMALY_TOTAL = _REGISTRY.counter(
     "mxtpu_anomaly_total",
     "watchdog detector firings, by kind (nan / loss_spike / "
-    "grad_explosion / step_time / queue_saturation) — detection only, "
-    "training numerics are never touched")
+    "grad_explosion / step_time / queue_saturation / input_wait) — "
+    "detection only, training numerics are never touched")
 
 # -- serving request-phase decomposition (correlated tracing) --------------
 
@@ -467,6 +501,11 @@ SERVE_PHASE_SECONDS = _REGISTRY.histogram(
     "actually went",
     buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
              0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+SERVE_SCHED_WAIT_SECONDS = _REGISTRY.counter(
+    "mxtpu_serving_sched_wait_seconds_total",
+    "scheduler-loop wall time blocked waiting for work on the admission "
+    "queue, by model — the serving-side analogue of the prefetch-wait "
+    "counter (high fraction = the batcher idles, not the device)")
 
 
 # ---------------------------------------------------------------------------
@@ -538,6 +577,8 @@ def record_trainer_step(t0: float, t1: float, grad_norm=None):
         # keeps the latest lazy value; trace events just omit it)
         args["grad_norm"] = grad_norm
     _TRACER.record("trainer.step", cat="trainer", ts=t0, dur=dt, args=args)
+    if attribution.ENABLED:
+        attribution.record_step(t0, t1, site="trainer")
 
 
 def record_superstep(k: int, t0: float, t1: float, grad_norm=None):
@@ -557,6 +598,8 @@ def record_superstep(k: int, t0: float, t1: float, grad_norm=None):
         step = _TRACER.mark_step()
     _TRACER.record("trainer.superstep", cat="trainer", ts=t0, dur=dt,
                    args={"k": int(k), "step": step})
+    if attribution.ENABLED:
+        attribution.record_step(t0, t1, k=k, site="superstep")
 
 
 def record_superstep_series(losses, gnorms=None, overflows=None):
@@ -621,6 +664,15 @@ def record_h2d(nbytes: int, dt: float, depth: int):
     DATA_PREFETCH_QUEUE_DEPTH.set(depth)
     _TRACER.record("data.h2d", cat="io", ts=_time.perf_counter() - dt,
                    dur=dt, args={"bytes": nbytes, "queue_depth": depth})
+
+
+def record_ckpt_tick(dt: float):
+    """In-LOOP checkpoint entry cost (resilience/checkpoint.py on_step:
+    interval bookkeeping + snapshot dispatch + writer-queue handoff) —
+    the slice the attribution plane charges to ckpt_overhead."""
+    CHECKPOINT_TICK_SECONDS.inc(dt)
+    _TRACER.record("checkpoint.tick", cat="resilience",
+                   ts=_time.perf_counter() - dt, dur=dt)
 
 
 def record_serve_batch(model: str, bucket, n_valid: int, capacity: int,
@@ -838,6 +890,7 @@ from .serve import (  # noqa: E402,F401
 )
 from . import federation  # noqa: E402,F401
 from . import watchdog  # noqa: E402,F401
+from . import attribution  # noqa: E402,F401
 
 # MXTPU_DUMP_ON_CRASH: hooks install at import (opt-in via env only —
 # without the var this is a dict read and nothing else)

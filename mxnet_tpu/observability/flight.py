@@ -206,6 +206,14 @@ def build_bundle(reason: str) -> dict:
     except Exception:
         pass
     try:
+        # the last-N per-step phase records: a dying job's bundle says
+        # WHERE its final steps spent their time, not just how long
+        from . import attribution
+
+        bundle["phase_records"] = attribution.records()[-32:]
+    except Exception:
+        bundle["phase_records"] = []
+    try:
         import jax
 
         bundle["backend"] = jax.default_backend()

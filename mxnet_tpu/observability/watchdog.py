@@ -17,7 +17,12 @@ training numerics (detection only):
                      x the warmup baseline mean,
 - ``queue_saturation`` — serving queue depth at >= 90% of the bound
                      (load shedding imminent), latched per model until
-                     it drains below half.
+                     it drains below half,
+- ``input_wait``   — the attribution plane's per-step input-wait delta
+                     (``mxtpu_data_prefetch_wait_delta_seconds``) above
+                     ``_INPUT_FRACTION`` of the step period: the
+                     accelerator idles on the host (raise
+                     MXTPU_DEVICE_PREFETCH / add loader workers).
 
 Every firing increments ``mxtpu_anomaly_total{kind=...}``, records an
 ``anomaly`` trace instant, and notes itself into the crash flight
@@ -51,6 +56,8 @@ _SPIKE_FACTOR = 10.0     # loss vs trailing median
 _GRAD_FACTOR = 25.0      # grad norm vs trailing median
 _STEP_FACTOR = 3.0       # recent mean step time vs warmup baseline
 _QUEUE_FRACTION = 0.9    # queue depth vs bound
+_INPUT_FRACTION = 0.5    # per-step input wait vs step period
+_INPUT_FLOOR_S = 0.001   # ignore sub-ms waits (tight loops are noise)
 _WINDOW = 64             # trailing-window capacity
 _MIN_WINDOW = 8          # observations before median detectors arm
 _WARMUP_STEPS = 10       # step-time observations forming the baseline
@@ -64,6 +71,7 @@ _STATE = {
     "prev_sum": 0.0,           # cumulative step-time at last check
     "prev_count": 0,
     "queue_latched": set(),    # models latched on queue saturation
+    "input_seen_step": 0,      # attribution record already consumed
     "last_poll": 0.0,
     "ckpt_mgr": None,
     "anomalies": collections.deque(maxlen=32),
@@ -108,6 +116,7 @@ def reset():
         _STATE["prev_sum"] = 0.0
         _STATE["prev_count"] = 0
         _STATE["queue_latched"] = set()
+        _STATE["input_seen_step"] = 0
         _STATE["last_poll"] = 0.0
         _STATE["anomalies"].clear()
         _STATE["ckpt_mgr"] = None
@@ -280,6 +289,33 @@ def _check_serving(fired):
             fired.append("queue_saturation")
 
 
+def _check_input_wait(fired):
+    """Input starvation: the attribution plane's LAST per-step record
+    says the consumer spent >= ``_INPUT_FRACTION`` of the step period
+    blocked on the prefetch queue (and at least ``_INPUT_FLOOR_S`` —
+    micro-benchmark loops idle in sub-ms noise). Consumed once per new
+    attribution record, so a stale record never re-fires."""
+    from . import attribution
+
+    rec = attribution.last_record()
+    if rec is None:
+        return
+    step = int(rec.get("step") or 0)
+    with _LOCK:
+        if step <= _STATE["input_seen_step"]:
+            return
+        _STATE["input_seen_step"] = step
+    per_step = rec["period_s"] / max(rec["k"], 1)
+    wait = rec["input_wait"]
+    if per_step > 0 and wait >= _INPUT_FLOOR_S and \
+            wait >= _INPUT_FRACTION * per_step:
+        _fire("input_wait", wait_s=wait, step_s=per_step,
+              fraction=round(wait / per_step, 4),
+              max_single_wait_s=rec.get("input_wait_max_s", 0.0),
+              step=step)
+        fired.append("input_wait")
+
+
 def check_now() -> list:
     """Run every detector once; returns the kinds fired this sweep.
     Deterministic — the test seam (``poll()``/the daemon loop add only
@@ -288,6 +324,7 @@ def check_now() -> list:
     _check_training(fired)
     _check_step_time(fired)
     _check_serving(fired)
+    _check_input_wait(fired)
     return fired
 
 

@@ -14,6 +14,8 @@ handles bound to tracers.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -983,6 +985,8 @@ class SPMDTrainStep:
             _obs.introspect.register_jit(
                 "spmd_step", self._compiled,
                 _obs.introspect.avals_of(args), donated=self._donate)
+        att = _obs.ENABLED and _obs.attribution.ENABLED
+        t0 = time.perf_counter() if att else 0.0
         if _obs.flight.INSTALLED:
             with _obs.flight.dispatch("spmd_step"):
                 out = self._compiled(*args)
@@ -990,6 +994,12 @@ class SPMDTrainStep:
             out = self._compiled(*args)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("spmd_step")
+            if att:
+                # comm is in-graph here — the overlap probe's hint (by
+                # mode) stands in for the unobservable wire time
+                _obs.attribution.record_step(
+                    t0, time.perf_counter(), site="spmd",
+                    comm_mode=self._mode)
         if self._mode == "overlap":
             new_params, new_states, new_res, loss = out
             if self._compress_thr is not None:
@@ -1005,12 +1015,23 @@ class SPMDTrainStep:
         the exposed-comm baseline the overlap mode is measured against."""
         st = self._staged
         params, opt_states = self._state
+        att = _obs.ENABLED and _obs.attribution.ENABLED
+        t0 = time.perf_counter() if att else 0.0
         gstack, austack, lstack = st["bwd"](params, raw_x, raw_y, key)
+        tc = time.perf_counter() if att else 0.0
         reds, auxs, loss = st["comm"](gstack, austack, lstack)
+        if att:
+            # the comm leg is a separate host-driven dispatch here —
+            # its host-side span IS observable, so attribution gets a
+            # measured figure instead of the overlap-probe hint
+            _obs.attribution.note_comm(time.perf_counter() - tc)
         new_params, new_states = st["upd"](params, opt_states, reds,
                                            auxs, lr_arr)
         if _obs.ENABLED:
             _obs.record_xla_dispatch("spmd_step", 3)
+            if att:
+                _obs.attribution.record_step(
+                    t0, time.perf_counter(), site="spmd_staged")
         self._state = (new_params, new_states)
         return loss
 
@@ -1210,6 +1231,8 @@ class SPMDTrainStep:
             _obs.introspect.register_jit(
                 "spmd_superstep", self._run_super,
                 _obs.introspect.avals_of(args), donated=self._donate)
+        att = _obs.ENABLED and _obs.attribution.ENABLED
+        t0 = time.perf_counter() if att else 0.0
         if _obs.flight.INSTALLED:
             with _obs.flight.dispatch("spmd_superstep"):
                 out = self._run_super(*args)
@@ -1225,6 +1248,10 @@ class SPMDTrainStep:
             _obs.record_xla_dispatch("spmd_superstep")
             # per-iteration in-scan loss series, stored whole and lazy
             _obs.record_superstep_series(losses)
+            if att:
+                _obs.attribution.record_step(
+                    t0, time.perf_counter(), k=k, site="spmd_superstep",
+                    comm_mode=self._mode)
         self._state = (new_params, new_states)
         self._last_loss = losses[-1]
         return losses

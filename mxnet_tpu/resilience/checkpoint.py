@@ -772,7 +772,15 @@ class CheckpointManager:
         if cursor is not None:
             self._cursor = cursor
         if self._step // self.every_n_steps > before // self.every_n_steps:
-            self.save_async(reason="interval")
+            if _obs.ENABLED:
+                # the in-LOOP slice only (snapshot dispatch + writer
+                # handoff) — the background write is never loop time;
+                # the attribution plane charges this to ckpt_overhead
+                t0 = time.perf_counter()
+                self.save_async(reason="interval")
+                _obs.record_ckpt_tick(time.perf_counter() - t0)
+            else:
+                self.save_async(reason="interval")
         return self._step
 
     @property

@@ -25,6 +25,7 @@ import threading
 import time
 
 from .errors import EngineClosed, ServerOverloaded
+from .. import observability as _obs
 
 #: queue sentinel: close() enqueues it BEHIND already-accepted requests,
 #: so the drain processes everything admitted before the close.
@@ -116,11 +117,12 @@ class ContinuousBatcher:
     _GUARDED_BY = {"_closed": "_close_lock", "_thread": "_close_lock"}
 
     def __init__(self, dispatch, *, max_batch, max_wait, queue_cap,
-                 on_expire=None, autostart=True):
+                 on_expire=None, autostart=True, name="default"):
         self._dispatch = dispatch
         self._max_batch = int(max_batch)
         self._max_wait = float(max_wait)
         self._on_expire = on_expire
+        self._name = str(name)  # metric label: the model this serves
         self._queue = queue.Queue(maxsize=int(queue_cap))
         self._closed = False
         self._close_lock = threading.Lock()
@@ -231,10 +233,17 @@ class ContinuousBatcher:
             wake = self._next_wake(pending)
             timeout = None if wake is None else \
                 max(0.0, wake - time.perf_counter())
+            t0 = time.perf_counter()
             try:
                 item = self._queue.get(timeout=timeout)
             except queue.Empty:
                 item = None
+            if _obs.ENABLED:
+                # idle-vs-busy split for the scheduler thread: blocked-
+                # on-admission wall time (the serving analogue of the
+                # prefetch consumer-wait counter; a counter inc, no sync)
+                _obs.SERVE_SCHED_WAIT_SECONDS.inc(
+                    time.perf_counter() - t0, model=self._name)
             closing = item is _CLOSE
             if item is not None and not closing:
                 self._admit(pending, item)

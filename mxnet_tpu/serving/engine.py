@@ -125,7 +125,8 @@ class InferenceEngine:
         self._batcher = ContinuousBatcher(
             self._execute, max_batch=self._max_batch,
             max_wait=self._max_wait, queue_cap=self._queue_cap,
-            on_expire=self._on_expire, autostart=autostart)
+            on_expire=self._on_expire, autostart=autostart,
+            name=self._name)
 
     # -- bucket geometry ---------------------------------------------------
     @staticmethod

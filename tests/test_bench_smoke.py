@@ -73,6 +73,11 @@ def _federation_rec(recs):
     return fd[0] if fd else None
 
 
+def _train_fused_rec(recs):
+    tf = [r for r in recs if r["metric"].startswith("train_step_fused")]
+    return tf[0] if tf else None
+
+
 #: the shared BENCH_ONLY re-run contract: a timing/pressure-sensitive
 #: assert that fails during the FULL run gets exactly one clean-
 #: subprocess retry of JUST its scenario (host pressure across a 10-
@@ -81,6 +86,7 @@ def _federation_rec(recs):
 #: the full run's committed records stay what the other asserts see.
 #: scenario name -> (record picker, env keys of its record outputs)
 _STANDALONE = {
+    "train_step": (_train_fused_rec, ("BENCH_PR3_OUT",)),
     "input_pipeline": (_warm_cache_rec, ("BENCH_PR4_OUT",)),
     "checkpoint": (_ckpt_rec, ("BENCH_PR8_OUT",)),
     "overlap": (_overlap_rec, ("BENCH_PR10_OUT",)),
@@ -310,6 +316,46 @@ def test_bench_emits_driver_contract(tmp_path):
     # (pinned sites + rule catalog + baseline size, PR 14)
     assert "Graph contracts" in rep.stdout, rep.stdout[-2000:]
     assert "spmd_step" in rep.stdout
+    # the attribution section renders from the same dump (PR 16)
+    assert "Attribution" in rep.stdout, rep.stdout[-2000:]
+    # step-time attribution (PR16): the train_step rows stamp per-phase
+    # fields whose sum reconstructs the measured step wall within 10%
+    # (host pressure on the one-shot timing gets the standalone retry)
+    tf = _train_fused_rec(recs)
+    assert tf, names
+    if not ("phase_sum_ms" in tf and
+            abs(tf["phase_sum_ms"] - tf["step_ms"]) <=
+            0.10 * tf["step_ms"]):
+        tf, res2 = _rerun_standalone(env, "train_step")
+        assert tf and "phase_sum_ms" in tf \
+            and abs(tf["phase_sum_ms"] - tf["step_ms"]) <= \
+            0.10 * tf["step_ms"], \
+            (tf, res.stderr[-1000:], res2.stderr[-1000:])
+    for ph in ("input_wait", "h2d", "ckpt_overhead", "comm_exposed",
+               "compute", "host_gap"):
+        assert tf[f"phase_{ph}_ms"] >= 0.0, tf
+    pr3 = json.load(open(env["BENCH_PR3_OUT"]))
+    assert pr3["_phases"]["fused"]["compute_ms"] >= 0.0, pr3
+    # mxtpu-doctor renders a verdict from the bench telemetry for the
+    # train_step AND serving scenarios (tier-1 doctor smoke, PR16)
+    doc = sp.run([sys.executable,
+                  os.path.join(ROOT, "tools", "mxtpu_doctor.py"),
+                  "--json", str(tel)],
+                 capture_output=True, text=True, timeout=60)
+    assert doc.returncode == 0, doc.stderr
+    report = json.loads(doc.stdout)
+    assert report["format"] == "mxtpu-doctor-v1", report
+    sys.path.insert(0, ROOT)
+    from tools.mxtpu_doctor import RECIPES
+    train_sites = {v["site"] for v in report["training"]}
+    known = set(RECIPES)
+    assert {"trainer", "superstep"} & train_sites, report
+    for v in report["training"]:
+        assert v["verdict"] in known and v["recipe"], v
+    assert report["serving"], report  # bench_serving arms telemetry
+    for v in report["serving"]:
+        assert v["verdict"] in known and v["requests"] > 0, v
+    assert "top" in report, report
 
 
 _HARNESS_RUNNER = """

@@ -253,6 +253,25 @@ def main(argv=None) -> int:
         "baseline": args.baseline,
         "failures": failures,
     }
+    doctor_line = ""
+    if failures:
+        # the doctor's phase attribution says WHICH phase moved — one
+        # line here, full table via `mxtpu_doctor.py --diff` (absent
+        # phase stamps / a missing doctor module just skip the line)
+        try:
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "_bd_doctor", os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "mxtpu_doctor.py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            doctor_line = mod.phase_diff_one_liner(args.baseline, args.new)
+        except Exception:
+            doctor_line = ""
+    if doctor_line:
+        verdict["doctor"] = doctor_line
     if args.json:
         print(json.dumps(verdict, indent=2, sort_keys=True))
     else:
@@ -261,6 +280,8 @@ def main(argv=None) -> int:
               f"direction-aware; {skipped} informational skipped)")
         for f in failures:
             print(f"  REGRESSION {f['detail']}")
+        if doctor_line:
+            print(f"  {doctor_line}")
         print("bench_diff: PASS" if not failures
               else f"bench_diff: FAIL ({len(failures)} regression(s))")
     return 0 if not failures else 1

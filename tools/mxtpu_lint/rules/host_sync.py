@@ -71,6 +71,12 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/observability/watchdog.py", "poll"),
     ("mxnet_tpu/observability/watchdog.py", "check_now"),
     ("mxnet_tpu/observability/watchdog.py", "_watchdog_loop"),
+    # step-time attribution: runs at every step boundary and must stay
+    # pure host arithmetic over already-recorded floats (the zero-
+    # added-dispatch guarantee the regression test pins)
+    ("mxnet_tpu/observability/attribution.py", "record_step"),
+    ("mxnet_tpu/observability/attribution.py", "note_input_wait"),
+    ("mxnet_tpu/observability/attribution.py", "note_comm"),
 ]
 
 #: int()/float() args that are NEVER device syncs: static shape

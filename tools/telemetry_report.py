@@ -240,6 +240,59 @@ def render_serving(events):
     return "\n".join(lines)
 
 
+#: the attribution plane's phase order (observability/attribution.py)
+_PHASES = ("input_wait", "h2d", "ckpt_overhead", "comm_exposed",
+           "compute", "host_gap")
+
+
+def render_attribution(events):
+    """'Attribution' section from the ``step.phases`` spans: per-site
+    mean per-step phase table with % of step. Same crash-proofing
+    contract as every other section: absent series -> empty string,
+    malformed args are skipped, a zero period renders nothing."""
+    acc = {}
+    for ev in events:
+        if ev.get("name") != "step.phases":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            continue
+        try:
+            k = max(int(args.get("k", 1)), 1)
+            period = float(args["period_ms"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        slot = acc.setdefault(str(args.get("site", "?")),
+                              {"k": 0, "period": 0.0,
+                               **{p: 0.0 for p in _PHASES}})
+        slot["k"] += k
+        slot["period"] += period
+        for p in _PHASES:
+            v = args.get(f"{p}_ms")
+            if isinstance(v, (int, float)):
+                slot[p] += float(v) * k  # args are per-step amortized
+    if not acc:
+        return ""
+    lines = ["", "Attribution (per-step phase decomposition):",
+             f"{'Site':<18}{'Steps':>7}{'ms/step':>10}  " +
+             "".join(f"{p:>15}" for p in _PHASES)]
+    for site in sorted(acc):
+        slot = acc[site]
+        kk = max(slot["k"], 1)
+        step_ms = slot["period"] / kk
+        if step_ms <= 0:
+            continue
+        cells = []
+        for p in _PHASES:
+            ms = slot[p] / kk
+            cells.append(f"{ms:>7.3f} {ms / step_ms * 100:>4.0f}%  ")
+        lines.append(f"{site:<18}{kk:>7}{step_ms:>10.3f}  "
+                     + "".join(f"{c:>15}" for c in cells))
+    lines.append("  (columns: mean ms/step and % of step period; see "
+                 "docs/observability.md 'Reading an attribution report')")
+    return "\n".join(lines)
+
+
 #: cost-record site -> the span series whose mean duration times it
 #: (a superstep span covers K iterations — and so does its FLOP count,
 #: so the ratio is still per-invocation-consistent)
@@ -479,6 +532,9 @@ def main(argv=None):
     roof = render_roofline(events)
     if roof:
         print(roof)
+    attribution = render_attribution(events)
+    if attribution:
+        print(attribution)
     serving = render_serving(events)
     if serving:
         print(serving)
