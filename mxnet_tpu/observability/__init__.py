@@ -507,6 +507,54 @@ SERVE_SCHED_WAIT_SECONDS = _REGISTRY.counter(
     "queue, by model — the serving-side analogue of the prefetch-wait "
     "counter (high fraction = the batcher idles, not the device)")
 
+# -- self-healing serving fleet (mxnet_tpu/serving/fleet.py) ---------------
+
+FLEET_REPLICAS = _REGISTRY.gauge(
+    "mxtpu_fleet_replicas",
+    "replicas in the serving fleet by model and health state (live / "
+    "suspect / dead / warm) — live below the autoscaler minimum means "
+    "recovery is in progress")
+FLEET_DISPATCH_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_dispatch_total",
+    "router dispatches by model and replica index — a skewed "
+    "distribution under uniform load means the depth feed sees a "
+    "straggler (or the consistent-hash fallback is active)")
+FLEET_RETRY_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_retry_total",
+    "failover retries onto a surviving replica, by model and reason "
+    "(dead / closed / pipe) — each is one request that would have hung "
+    "on a dead host without the router")
+FLEET_REPLICA_LOST_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_replica_lost_total",
+    "requests that exhausted EVERY candidate replica and surfaced a "
+    "typed ReplicaLost, by model — nonzero while any replica survives "
+    "is a router bug")
+FLEET_BROWNOUT = _REGISTRY.gauge(
+    "mxtpu_fleet_brownout",
+    "latched degraded-mode level by model: 0 normal, 1 shedding bulk, "
+    "2 shedding bulk+interactive (critical always admitted) — the loud "
+    "signal that the fleet is trading work for survival")
+FLEET_SHED_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_shed_total",
+    "requests refused by the brownout policy, by model and priority "
+    "class — sheds must appear at bulk before interactive before "
+    "critical (strict priority order)")
+FLEET_AUTOSCALE_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_autoscale_total",
+    "autoscaler actuations by model and action (grow / shrink / "
+    "replace / to_zero / restore), routed through the elastic "
+    "membership signal queue")
+FLEET_HEDGED_TOTAL = _REGISTRY.counter(
+    "mxtpu_fleet_hedged_total",
+    "hedged duplicate dispatches (MXTPU_FLEET_HEDGE_MS > 0), by model "
+    "— first result wins, the loser is discarded (inference is "
+    "idempotent)")
+FLEET_RECOVERY_SECONDS = _REGISTRY.gauge(
+    "mxtpu_fleet_recovery_seconds",
+    "wall time from the last detected replica death to the autoscaler's "
+    "replacement replica serving again, by model — the chaos "
+    "certification budget in bench.py fleet")
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)
@@ -744,6 +792,33 @@ def record_serve_phases(model: str, req_id: int, t_submit: float,
         args[f"{phase}_ms"] = round(dur * 1e3, 3)
     _TRACER.record("serving.request", cat="serving", ts=t_submit,
                    dur=total, args=args)
+
+
+def record_fleet_states(model: str, counts: dict):
+    """Publish the fleet's replica census: ``counts`` maps health state
+    (live / suspect / dead / warm) -> replica count. States absent from
+    ``counts`` are zeroed so a recovered fleet stops advertising dead
+    rows."""
+    for state in ("live", "suspect", "dead", "warm"):
+        FLEET_REPLICAS.set(float(counts.get(state, 0)), model=model,
+                           state=state)
+
+
+def record_fleet_brownout(model: str, level: int, prev: int):
+    """One brownout state-machine transition: the latched level gauge
+    plus a loud trace instant (direction says entering vs draining)."""
+    FLEET_BROWNOUT.set(float(level), model=model)
+    _TRACER.instant("fleet.brownout", cat="serving", model=model,
+                    level=int(level), prev=int(prev),
+                    direction="enter" if level > prev else "exit")
+
+
+def record_fleet_autoscale(model: str, action: str, n: int):
+    """One autoscaler actuation (grow / shrink / replace / to_zero /
+    restore) with the resulting replica target."""
+    FLEET_AUTOSCALE_TOTAL.inc(1, model=model, action=action)
+    _TRACER.instant("fleet.autoscale", cat="serving", model=model,
+                    action=action, target=int(n))
 
 
 def serve_phase_snapshot(model: str) -> dict:

@@ -266,6 +266,43 @@ def stale_ranks(now=None) -> list:
     return sorted(r for r, age in ages.items() if age > limit)
 
 
+def cluster_values(metric, match=None, fresh_only=True, now=None):
+    """Consumer API: per-rank values of one scalar metric across the
+    ingested cluster table — ``{rank: float}``.
+
+    ``match`` filters labelsets by a subset dict (e.g. ``{"model":
+    "resnet"}``); multiple surviving labelsets per rank are summed.
+    With ``fresh_only`` (default) stale ranks are EXCLUDED — a
+    consumer that gets ``{}`` back knows the federation is cold and
+    must fall back to local signals (the fleet router's
+    consistent-hash fallback). Histogram/series metrics are skipped:
+    this reads the scalar plane (queue depths, counters, gauges)."""
+    match = match or {}
+    stale = set(stale_ranks(now)) if fresh_only else ()
+    out = {}
+    with _CLUSTER_LOCK:
+        snaps = {r: e["snap"] for r, e in _CLUSTER.items()
+                 if r not in stale}
+    for rank, snap in snaps.items():
+        entry = (snap.get("metrics") or {}).get(metric)
+        if not entry or entry.get("kind") in ("histogram", "series_gauge"):
+            continue
+        total, hit = 0.0, False
+        for enc, value in (entry.get("values") or {}).items():
+            try:
+                labels = dict(_decode_key(enc))
+            except Exception:
+                continue
+            if any(labels.get(k) != str(v) for k, v in match.items()):
+                continue
+            if isinstance(value, (int, float)) and value == value:
+                total += float(value)
+                hit = True
+        if hit:
+            out[rank] = total
+    return out
+
+
 def update_cluster_meta(now=None):
     """Refresh the federation meta gauges in the LOCAL registry (they
     ride the next snapshot like any other series): rank count, per-rank

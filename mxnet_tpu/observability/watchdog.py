@@ -79,9 +79,15 @@ _STATE = {
 }
 _LOCK = threading.RLock()
 
+#: anomaly listeners (actuators): ``fn(kind, details)`` called on every
+#: firing — how detection becomes ACTION (the fleet autoscaler turns
+#: ``queue_saturation`` into a scale-up). Mutated under ``_LOCK``,
+#: called OUTSIDE it (a slow actuator must not block detection).
+_LISTENERS = []
+
 #: machine-checked lock protocol (mxtpu-lint thread-guard): detector
 #: state is shared between the trainer poll path and the daemon loop
-_GUARDED_BY = {"_STATE": "_LOCK"}
+_GUARDED_BY = {"_STATE": "_LOCK", "_LISTENERS": "_LOCK"}
 
 
 def watchdog_interval_s() -> float:
@@ -121,6 +127,28 @@ def reset():
         _STATE["anomalies"].clear()
         _STATE["ckpt_mgr"] = None
         _STATE["note_registered"] = False
+        del _LISTENERS[:]
+
+
+def register_listener(fn):
+    """Register an anomaly actuator: ``fn(kind, details)`` runs on
+    every detector firing (after the counter/trace/flight plumbing),
+    outside the detector lock. Actuator exceptions are swallowed —
+    a broken actuator must never break detection. Returns ``fn`` so it
+    can be used as a decorator; idempotent per function object."""
+    with _LOCK:
+        if fn not in _LISTENERS:
+            _LISTENERS.append(fn)
+    return fn
+
+
+def unregister_listener(fn):
+    """Remove a previously registered actuator (idempotent)."""
+    with _LOCK:
+        try:
+            _LISTENERS.remove(fn)
+        except ValueError:
+            pass
 
 
 def attach_checkpoint_manager(mgr):
@@ -161,11 +189,17 @@ def _fire(kind: str, **details):
             except Exception:
                 _STATE["note_registered"] = False
         mgr = _STATE["ckpt_mgr"]
+        listeners = list(_LISTENERS)
     if mgr is not None and _checkpoint_on_anomaly():
         try:
             mgr.save_async(reason="anomaly")
         except Exception:
             pass  # a failed proactive save must never break detection
+    for fn in listeners:  # outside _LOCK: actuators may be slow
+        try:
+            fn(kind, dict(details))
+        except Exception:
+            pass  # a broken actuator must never break detection
 
 
 def _median(xs):

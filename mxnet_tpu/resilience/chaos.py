@@ -26,6 +26,13 @@ Spec grammar (comma-separated faults)::
     stall@rank1:p1:0.05  per-rank site: every heartbeat probe of rank 1
                       stalls 50 ms (how a chaos-stalled straggler peer
                       is simulated on a single-host mesh)
+    kill_replica@fleet:40:1  serving-fleet host kill: at the fleet's
+                      dispatch-step 40, hard-kill replica 1 (SIGKILL
+                      for a process replica — in-flight requests on it
+                      must fail over, never hang; arg defaults to 0)
+    stall@replica2:p1:0.05  stall every dispatch onto replica 2 by
+                      50 ms (serving straggler; feeds the router's
+                      queue-depth avoidance and hedging)
     nan:p0.1,seed=7   probabilistic: each eligible step fires w.p. 0.1
                       from a seeded stream (deterministic given seed)
 
@@ -58,6 +65,11 @@ Sites currently wired (docs/robustness.md has the catalog):
   runtime grow/shrink to ``n`` devices at that step boundary;
   ``rank<k>`` sites stall individual heartbeat probes (straggler
   simulation)
+- ``fleet`` / ``replica<k>`` — the serving fleet
+  (``serving/fleet.py``): ``kill_replica@fleet:<step>[:<k>]``
+  hard-kills replica ``k`` at the fleet's dispatch-step counter
+  (``kill_replica_due``); ``stall@replica<k>`` stalls that replica's
+  dispatch path (``step_point`` per replica site)
 """
 
 from __future__ import annotations
@@ -89,7 +101,7 @@ _STATE = {
 }
 
 _FAULT_KINDS = ("kill", "term", "raise", "nan", "stall", "collective",
-                "resize")
+                "resize", "kill_replica")
 
 
 class ChaosInjectedError(MXNetError):
@@ -100,7 +112,7 @@ class ChaosInjectedError(MXNetError):
 def _parse_one(tok):
     """``kind[@site]:step-or-pP[:arg]`` -> fault dict."""
     m = re.match(
-        r"^(?P<kind>[a-z]+)(@(?P<site>[a-zA-Z_][a-zA-Z0-9_]*))?"
+        r"^(?P<kind>[a-z_]+)(@(?P<site>[a-zA-Z_][a-zA-Z0-9_]*))?"
         r"(:(?P<when>p?[0-9.]+))?(:(?P<arg>[0-9.]+))?$", tok.strip())
     if not m or m.group("kind") not in _FAULT_KINDS:
         raise MXNetError(
@@ -304,6 +316,23 @@ def resize_due(site="elastic", step=None):
             continue
         _record(fault, site, step)
         return int(float(fault["arg"]))
+    return None
+
+
+def kill_replica_due(site="fleet", step=None):
+    """Replica index of a due ``kill_replica`` fault at this (site,
+    step), or None. The serving fleet's dispatch path polls this once
+    per dispatch when chaos is armed; a returned index means "that
+    replica's host just died" — the fleet hard-kills it (SIGKILL for a
+    process replica) and the router/autoscaler recovery path takes
+    over. The arg is the replica index (default 0):
+    ``kill_replica@fleet:40:1`` kills replica 1 at dispatch 40."""
+    step = _advance("kill_replica", site, step)
+    for fault in _STATE["faults"]:
+        if fault["kind"] != "kill_replica" or not _due(fault, site, step):
+            continue
+        _record(fault, site, step)
+        return int(float(fault["arg"] or 0))
     return None
 
 

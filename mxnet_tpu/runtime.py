@@ -5,11 +5,17 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import time
 
 import jax
 
 _logger = logging.getLogger("mxnet_tpu.runtime")
+
+#: process-local RNG for retry jitter, seeded from OS entropy — every
+#: process in a fleet draws a DIFFERENT backoff sequence, which is the
+#: whole point (never seed this from a shared config value)
+_RETRY_RNG = random.Random()
 
 
 # ---------------------------------------------------------------------------
@@ -18,18 +24,46 @@ _logger = logging.getLogger("mxnet_tpu.runtime")
 # barrier all retry through here instead of each growing its own loop)
 # ---------------------------------------------------------------------------
 
+def backoff_delays(attempts, base_delay, max_delay=30.0, jitter=True,
+                   rng=None):
+    """The sleep schedule ``retry_with_backoff`` walks, as a list of
+    ``attempts - 1`` floats. With ``jitter`` (the default) it is
+    DEcorrelated jitter (AWS-style): ``d_i = min(max_delay,
+    uniform(base_delay, 3 * d_{i-1}))``, seeded per process — a fleet
+    of replicas reconnecting after a coordinator blip spreads out
+    instead of thundering-herding it in lockstep. ``jitter=False``
+    keeps the old deterministic linear ramp (``base_delay * i``) for
+    callers that need reproducible timing."""
+    attempts = max(1, int(attempts))
+    base_delay = float(base_delay)
+    if not jitter:
+        return [base_delay * i for i in range(1, attempts)]
+    r = rng if rng is not None else _RETRY_RNG
+    delays, prev = [], base_delay
+    for _ in range(attempts - 1):
+        prev = min(float(max_delay), r.uniform(base_delay, max(base_delay,
+                                                               prev * 3.0)))
+        delays.append(prev)
+    return delays
+
+
 def retry_with_backoff(fn, attempts=3, base_delay=2.0, desc="operation",
-                       retry_on=(Exception,), no_retry=(), logger=None):
-    """Call ``fn()`` up to ``attempts`` times with linear backoff
-    (``base_delay * attempt`` seconds between tries), logging each
-    failure LOUDLY. Re-raises the last exception when every attempt
-    fails — a transient infra hiccup retries, a real failure still
-    surfaces (never silently swallowed). Exception types in
+                       retry_on=(Exception,), no_retry=(), logger=None,
+                       jitter=True, max_delay=30.0, rng=None,
+                       sleep=time.sleep):
+    """Call ``fn()`` up to ``attempts`` times with backoff between
+    tries (decorrelated jitter by default — see :func:`backoff_delays`;
+    ``jitter=False`` restores the deterministic linear ramp), logging
+    each failure LOUDLY. Re-raises the last exception when every
+    attempt fails — a transient infra hiccup retries, a real failure
+    still surfaces (never silently swallowed). Exception types in
     ``no_retry`` surface IMMEDIATELY (e.g. a barrier watchdog timeout:
     the peers are gone, and re-entering the same barrier tag after
     abandoning a still-blocked watchdog thread could double-join)."""
     log = logger or _logger
     attempts = max(1, int(attempts))
+    delays = backoff_delays(attempts, base_delay, max_delay=max_delay,
+                            jitter=jitter, rng=rng)
     last = None
     for i in range(1, attempts + 1):
         try:
@@ -41,7 +75,7 @@ def retry_with_backoff(fn, attempts=3, base_delay=2.0, desc="operation",
             log.warning("%s attempt %d/%d failed: %s: %s", desc, i,
                         attempts, type(e).__name__, str(e)[:300])
             if i < attempts:
-                time.sleep(base_delay * i)
+                sleep(delays[i - 1])
     raise last
 
 

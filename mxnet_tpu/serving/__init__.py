@@ -15,10 +15,19 @@ primitives:
 - serving SLOs on the observability registry (p50/p99 latency,
   batch-fill, queue depth, shed/timeout counters — scrapeable via
   ``observability.serve_metrics``; ``tools/telemetry_report.py`` has a
-  Serving section).
+  Serving section);
+- the self-healing fleet layer — :class:`ServingFleet` /
+  :class:`ReplicaSet` (replicas across processes/hosts behind one
+  :class:`ReplicaRouter` with least-queue-depth dispatch, typed
+  failover and optional hedging), :class:`SLOAutoscaler` (watchdog +
+  SLO signals actuated through the PR-11 membership bus: grow, shrink,
+  scale-to-zero with warm-pool restore, cooldown-exempt replacement of
+  dead replicas), and the latched brownout degraded mode (``bulk``
+  sheds before ``interactive`` before ``critical``).
 
 Knobs: ``MXTPU_SERVE_MAX_BATCH`` / ``MXTPU_SERVE_MAX_WAIT_MS`` /
-``MXTPU_SERVE_QUEUE`` (docs/env_vars.md); recipe: docs/serving.md.
+``MXTPU_SERVE_QUEUE`` + the ``MXTPU_FLEET_*`` family
+(docs/env_vars.md); recipes: docs/serving.md, docs/robustness.md.
 """
 
 from __future__ import annotations
@@ -31,7 +40,11 @@ from .engine import (  # noqa: F401
     serve_queue_cap,
 )
 from .errors import (  # noqa: F401
+    BrownoutShed,
     EngineClosed,
+    ReplicaDead,
+    ReplicaLost,
+    RequestCancelled,
     RequestTimeout,
     RequestTooLarge,
     RetraceForbidden,
@@ -40,3 +53,25 @@ from .errors import (  # noqa: F401
     StagedLoadError,
 )
 from .repository import ModelRepository  # noqa: F401
+from .replica import LocalReplica, ProcessReplica  # noqa: F401
+from .router import (  # noqa: F401
+    FleetFuture,
+    ReplicaRouter,
+    federation_depth_feed,
+    fleet_hedge_ms,
+    fleet_retries,
+)
+from .fleet import (  # noqa: F401
+    PRIORITIES,
+    ReplicaSet,
+    ServingFleet,
+    fleet_brownout_enter,
+    fleet_brownout_exit,
+    fleet_brownout_hold_s,
+    fleet_heartbeat_s,
+    fleet_max_replicas,
+    fleet_min_replicas,
+    fleet_replicas,
+    fleet_suspect_misses,
+)
+from .autoscaler import SLOAutoscaler, fleet_cooldown_s, fleet_slo_p99_ms  # noqa: F401

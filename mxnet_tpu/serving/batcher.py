@@ -44,7 +44,7 @@ class _Request:
 
     __slots__ = ("payload", "rows", "bucket", "t_submit", "deadline",
                  "event", "result", "error", "version", "req_id",
-                 "t_assembly")
+                 "t_assembly", "claimed", "cancelled", "_state_lock")
 
     def __init__(self, payload, rows, bucket, deadline=None):
         self.payload = payload
@@ -58,11 +58,41 @@ class _Request:
         self.version = None
         self.req_id = next(_REQ_IDS)
         self.t_assembly = None  # stamped when batch assembly picks it up
+        # claim/cancel CAS: exactly one of {batch assembly, client
+        # cancel} wins a queued request; the loser sees False
+        self.claimed = False
+        self.cancelled = False
+        self._state_lock = threading.Lock()
 
     def finish(self, result=None, error=None):
         self.result = result
         self.error = error
         self.event.set()
+
+    def claim(self) -> bool:
+        """Batch assembly takes ownership: False iff the client already
+        cancelled (or the request is otherwise terminal) — the entry is
+        skipped at drain time, its slot going to the next request."""
+        with self._state_lock:
+            if self.cancelled or self.event.is_set():
+                return False
+            self.claimed = True
+            return True
+
+    def cancel(self) -> bool:
+        """Client-side withdrawal: wins only while still queued (never
+        claimed by assembly, not yet terminal). On success the request
+        finishes with a typed :class:`RequestCancelled`."""
+        from .errors import RequestCancelled
+
+        with self._state_lock:
+            if self.claimed or self.event.is_set():
+                return False
+            self.cancelled = True
+        self.finish(error=RequestCancelled(
+            "request cancelled by the client while queued (never "
+            "dispatched; the queue slot is reclaimed at the next drain)"))
+        return True
 
 
 class ServeFuture:
@@ -86,15 +116,30 @@ class ServeFuture:
         (``serving.submit`` / ``serving.request``) carry."""
         return self._req.req_id
 
+    def cancel(self) -> bool:
+        """Withdraw a still-queued request: True iff the cancel won the
+        race against batch assembly. On True the request is NEVER
+        dispatched, its queue slot is reclaimed at the next drain, and
+        ``result()`` raises :class:`RequestCancelled`. On False the
+        request already entered a batch (or finished) — its original
+        outcome stands. A caller abandoning ``result(timeout=)`` should
+        cancel() so its slot stops occupying the bounded queue."""
+        return self._req.cancel()
+
+    def cancelled(self) -> bool:
+        return self._req.cancelled
+
     def result(self, timeout=None):
         """Block for the outcome; raises the request's typed error
         (RequestTimeout / EngineClosed / ...) if it failed. ``timeout``
         here is the CLIENT's patience — hitting it raises TimeoutError
-        without cancelling the request."""
+        without cancelling the request (call :meth:`cancel` to also
+        withdraw it)."""
         if not self._req.event.wait(timeout):
             raise TimeoutError(
                 f"serving result not ready within {timeout}s (the request "
-                "is still in flight; its own deadline governs shedding)")
+                "is still in flight; its own deadline governs shedding — "
+                "cancel() withdraws it if it is still queued)")
         if self._req.error is not None:
             raise self._req.error
         return self._req.result
@@ -114,7 +159,8 @@ class ContinuousBatcher:
     #: lifecycle state flips only under the close lock — submit/close
     #: racing on `_closed`, or two closers both joining `_thread`, was
     #: exactly the shutdown flake class PR-8 retired for checkpoints
-    _GUARDED_BY = {"_closed": "_close_lock", "_thread": "_close_lock"}
+    _GUARDED_BY = {"_closed": "_close_lock", "_thread": "_close_lock",
+                   "_abort": "_close_lock"}
 
     def __init__(self, dispatch, *, max_batch, max_wait, queue_cap,
                  on_expire=None, autostart=True, name="default"):
@@ -125,6 +171,7 @@ class ContinuousBatcher:
         self._name = str(name)  # metric label: the model this serves
         self._queue = queue.Queue(maxsize=int(queue_cap))
         self._closed = False
+        self._abort = None  # error factory set by abort(); see _GUARDED_BY
         self._close_lock = threading.Lock()
         self._thread = None
         if autostart:
@@ -180,6 +227,8 @@ class ContinuousBatcher:
         for bucket, group in pending.items():
             kept = []
             for r in group:
+                if r.event.is_set():
+                    continue  # cancelled while pending: drop the entry
                 if r.deadline is not None and now >= r.deadline:
                     r.finish(error=RequestTimeout(
                         f"deadline expired after "
@@ -199,6 +248,8 @@ class ContinuousBatcher:
             take, rows = [], 0
             while group and rows + group[0].rows <= self._max_batch:
                 r = group.pop(0)
+                if not r.claim():
+                    continue  # cancelled entry: skipped at drain time
                 take.append(r)
                 rows += r.rows
             if not take:  # head alone exceeds max_batch: cannot happen
@@ -260,6 +311,16 @@ class ContinuousBatcher:
                 else:
                     self._admit(pending, extra)
             if closing:
+                with self._close_lock:
+                    abort = self._abort
+                if abort is not None:
+                    # abrupt death: FAIL everything pending instead of
+                    # dispatching it (waiters unblock typed, never hang)
+                    for group in pending.values():
+                        for r in group:
+                            if not r.event.is_set():
+                                r.finish(error=abort())
+                    return
                 # close-time drain: everything admitted before the
                 # close dispatches (partial batches go out padded)
                 self._sweep(pending, force=True)
@@ -267,6 +328,42 @@ class ContinuousBatcher:
             self._sweep(pending)
 
     # -- shutdown ----------------------------------------------------------
+    def abort(self, error_factory=None):
+        """Abrupt-death hook (fleet replica kill / host-death
+        simulation): refuse new submits and FAIL every queued request
+        with ``error_factory()`` instead of dispatching it — the
+        opposite of ``close()``'s graceful drain. In-flight waiters
+        unblock immediately with a typed error, never hang."""
+        def _default():
+            return EngineClosed("engine killed (abrupt replica death); "
+                                "queued work was failed, not drained")
+
+        make = error_factory or _default
+        with self._close_lock:
+            self._closed = True
+            self._abort = make
+            thread = self._thread
+        if thread is not None:
+            while True:  # a full queue drains continuously under _run
+                try:
+                    self._queue.put_nowait(_CLOSE)
+                    break
+                except queue.Full:
+                    time.sleep(0.001)
+            thread.join(timeout=10.0)
+        # whether or not a scheduler thread ever ran, nothing may stay
+        # queued: fail the stragglers here (idempotent with _run's own
+        # abort drain — finished requests are skipped)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not _CLOSE and not req.event.is_set():
+                req.finish(error=make())
+        with self._close_lock:
+            self._thread = None
+
     def close(self):
         """Idempotent: refuse new submits, drain accepted requests
         (partial batches dispatch), join the scheduler thread."""

@@ -394,6 +394,11 @@ class InferenceEngine:
     def sealed(self):
         return self._sealed
 
+    def queue_depth(self) -> int:
+        """Requests waiting in the admission queue right now — the
+        router's local least-queue-depth signal (one qsize read)."""
+        return self._batcher.qsize() if self._batcher is not None else 0
+
     def stats(self) -> dict:
         """Engine-local SLO snapshot (plain floats, works with global
         telemetry off). ``compiles`` is flat after seal — the
@@ -439,6 +444,26 @@ class InferenceEngine:
             max_wait=self._max_wait, queue_cap=self._queue_cap,
             on_expire=self._on_expire)
         self._paused = False
+
+    def kill(self):
+        """Abrupt host-death simulation (fleet chaos certification):
+        queued requests FAIL with a typed :class:`ReplicaDead` instead
+        of draining — their waiters unblock immediately, and the fleet
+        router fails them over to a surviving replica. Idempotent;
+        a no-op after ``close()``."""
+        from .errors import ReplicaDead
+
+        if self._closed:
+            return
+        self._closed = True
+        name = f"{self._name}:{self._version}"
+        if self._batcher is not None:
+            self._batcher.abort(lambda: ReplicaDead(
+                f"engine {name} killed (abrupt host death) with this "
+                "request queued — retry on a surviving replica"))
+        self._compiled = {}
+        self._params = None
+        self._fn = None
 
     def close(self):
         """Drain in-flight requests, then release: executables and

@@ -47,3 +47,34 @@ class RetraceForbidden(ServingError):
 class StagedLoadError(ServingError):
     """A staged model load failed build/warmup/verification. The stage
     was discarded — the previous live version never stopped serving."""
+
+
+class RequestCancelled(ServingError):
+    """The client cancelled a still-queued request (``ServeFuture.
+    cancel()``). The request was never dispatched — its queue slot is
+    reclaimed at the next drain and no compute was spent on it. A
+    request that already entered batch assembly can NOT be cancelled
+    (cancel() returns False); exactly one of {dispatch, cancel} wins."""
+
+
+class ReplicaDead(ServingError):
+    """ONE replica died with this request on it (host kill, broken
+    pipe, heartbeat death). An internal routing signal: the fleet
+    router catches it and retries the request on a surviving replica —
+    fleet callers only ever see :class:`ReplicaLost`, and only when
+    every candidate failed."""
+
+
+class ReplicaLost(ServingError):
+    """Fleet-level terminal failure: EVERY candidate replica was tried
+    (at most once each) and all failed with a replica-death class error.
+    Raised only after the router's retry-with-backoff is exhausted —
+    a single host kill never surfaces this while a survivor exists."""
+
+
+class BrownoutShed(ServerOverloaded):
+    """Degraded-mode load shed: the fleet's latched brownout state
+    machine refused this request's priority class (``bulk`` sheds
+    before ``interactive`` before ``critical``). Subclasses
+    :class:`ServerOverloaded` so existing 503 mappings apply, but typed
+    so clients can tell policy shedding from a full queue."""

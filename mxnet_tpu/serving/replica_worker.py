@@ -1,0 +1,132 @@
+"""Child-process main for :class:`~.replica.ProcessReplica`.
+
+``python -m mxnet_tpu.serving.replica_worker`` speaks the
+length-prefixed pickle RPC over stdin/stdout: ``init`` builds a private
+:class:`~.repository.ModelRepository` and loads the replica spec's
+model (staged + verified, through the persistent compile cache);
+``submit`` runs a request to completion on a small thread pool and
+streams the answer back with the model VERSION that produced it (the
+fleet's zero-stale-version proof reads this) plus the current queue
+depth (the router's load signal piggybacks on every response);
+``ping`` reports health inline; ``swap`` stages a new version in the
+background; ``close`` drains and exits.
+
+Anything the model or framework prints must not corrupt the frame
+stream, so stdout is rebound to stderr at startup and only the worker
+itself writes frames to the real stdout (under a lock — pool threads
+complete out of order).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def main():
+    out = sys.stdout.buffer
+    sys.stdout = sys.stderr  # stray prints must never hit the frame stream
+    inp = sys.stdin.buffer
+
+    # heavy imports AFTER the stream swap so import-time chatter is safe
+    from .replica import build_net, read_msg, write_msg
+    from .repository import ModelRepository
+
+    wlock = threading.Lock()
+
+    def reply(mid, **fields):
+        with wlock:
+            write_msg(out, dict(fields, id=mid))
+
+    repo = ModelRepository(keep=1)
+    name = "model"
+    pool = ThreadPoolExecutor(max_workers=16,
+                              thread_name_prefix="mxtpu-replica-worker")
+
+    def depth():
+        try:
+            return repo.engine(name).queue_depth()
+        except Exception:
+            return 0
+
+    def fail(mid, e):
+        reply(mid, ok=False, etype=type(e).__name__, emsg=str(e),
+              depth=depth())
+
+    def do_init(mid, msg):
+        nonlocal name
+        try:
+            spec = msg["spec"]
+            name = str(msg.get("name") or "model")
+            engine = repo.load(name, lambda: build_net(spec["net"]),
+                               spec["shapes"],
+                               version=spec.get("version"),
+                               **dict(spec.get("engine") or {}))
+            reply(mid, ok=True, result="ready", version=engine.version,
+                  depth=0)
+        except Exception as e:  # noqa: BLE001 - everything crosses the wire
+            fail(mid, e)
+
+    def do_submit(mid, msg):
+        try:
+            fut = repo.submit(name, msg["x"], **dict(msg.get("kwargs") or {}))
+            result = fut.result(timeout=60.0)
+            reply(mid, ok=True, result=result,
+                  version=getattr(fut, "version", None), depth=depth())
+        except Exception as e:  # noqa: BLE001
+            fail(mid, e)
+
+    def do_ping(mid, msg):
+        try:
+            try:
+                stats = repo.stats(name)
+            except Exception:
+                stats = {}
+            d = depth()
+            info = {"depth": d, "version": repo.live_version(name),
+                    "stats": stats}
+            reply(mid, ok=True, result=info, depth=d,
+                  version=info["version"])
+        except Exception as e:  # noqa: BLE001
+            fail(mid, e)
+
+    def do_swap(mid, msg):
+        try:
+            spec = msg["spec"]
+            engine = repo.load(name, lambda: build_net(spec["net"]),
+                               spec["shapes"],
+                               version=spec.get("version"),
+                               **dict(spec.get("engine") or {}))
+            reply(mid, ok=True, result=engine.version,
+                  version=engine.version, depth=depth())
+        except Exception as e:  # noqa: BLE001
+            fail(mid, e)
+
+    while True:
+        try:
+            msg = read_msg(inp)
+        except (EOFError, OSError):
+            break
+        op, mid = msg.get("op"), msg.get("id")
+        if op == "init":
+            do_init(mid, msg)          # inline: nothing else until ready
+        elif op == "submit":
+            pool.submit(do_submit, mid, msg)
+        elif op == "ping":
+            do_ping(mid, msg)          # inline: health must not queue
+        elif op == "swap":
+            pool.submit(do_swap, mid, msg)
+        elif op == "close":
+            reply(mid, ok=True, result="closing", depth=depth())
+            break
+        else:
+            reply(mid, ok=False, etype="ServingError",
+                  emsg=f"unknown op {op!r}")
+
+    pool.shutdown(wait=True)
+    repo.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -166,6 +166,14 @@ class ModelRepository:
             raise ServingError(f"no live version of model {name!r}")
         return live
 
+    def live_version(self, name):
+        """Version string of the live engine (None when nothing is
+        live) — the fleet's zero-stale-version assertions read this."""
+        with self._lock:
+            entry = self._models.get(name)
+            live = entry["live"] if entry else None
+        return live.version if live is not None else None
+
     def submit(self, name, x, **kwargs):
         """Submit to the CURRENT live version. A swap between the
         pointer read and the submit is retried onto the new version, so

@@ -1,0 +1,321 @@
+"""Fleet router: least-queue-depth dispatch with typed failover.
+
+One entry point (:meth:`ReplicaRouter.submit`) in front of N replicas:
+
+- **placement** — candidates are ordered by queue depth when a FRESH
+  depth signal exists: the cluster federation feed first
+  (``mxtpu_serving_queue_depth`` per rank via
+  :func:`~mxnet_tpu.observability.federation.cluster_values`), local
+  piggybacked depth observations second. Replicas with no fresh signal
+  (federation cold, no recent response) fall back to a CONSISTENT-HASH
+  ring on the request key, so placement stays deterministic and
+  cache-friendly instead of degrading to random under signal loss.
+- **failover** — a dispatch or wait that dies with a replica-death
+  class (:class:`ReplicaDead` / :class:`EngineClosed` pipe variants)
+  is retried with decorrelated-jitter backoff on the next candidate.
+  AT-MOST-ONCE per replica: a request's ``tried`` set burns each uid
+  permanently, so a flapping replica can never see the same request
+  twice. Only when EVERY candidate failed does the caller see a typed
+  :class:`ReplicaLost` — a single host kill is invisible to clients
+  while a survivor exists.
+- **hedging** (off by default, ``MXTPU_FLEET_HEDGE_MS``) — a request
+  stuck past the hedge budget dispatches a duplicate onto the next
+  candidate; first completion wins, the loser is dropped. Bounds tail
+  latency from a stalling replica at the cost of duplicate compute.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+import threading
+import time
+from collections import deque
+
+from .. import observability as _obs
+from ..base import getenv
+from ..runtime import backoff_delays
+from .errors import EngineClosed, ReplicaDead, ReplicaLost
+
+#: error classes that mean "this REPLICA is gone", triggering failover
+#: (anything else — timeout, shape refusal, cancel — is the request's
+#: own outcome and must surface unchanged)
+DEATH_ERRORS = (ReplicaDead, EngineClosed)
+
+
+def fleet_retries(default=0) -> int:
+    """``MXTPU_FLEET_RETRIES``: max failover dispatches per request
+    beyond the first (0 = every surviving candidate, the default)."""
+    return int(getenv("MXTPU_FLEET_RETRIES", default))
+
+
+def fleet_hedge_ms(default=0.0) -> float:
+    """``MXTPU_FLEET_HEDGE_MS``: hedge a request onto a second replica
+    after this many ms without a result (0 = hedging off, default)."""
+    return float(getenv("MXTPU_FLEET_HEDGE_MS", default))
+
+
+def federation_depth_feed(rank_of):
+    """Build a ``depth_feed`` reading per-rank queue depth from the
+    PR-15 federation plane. ``rank_of(replica) -> rank`` maps fleet
+    replicas onto federation ranks. Returns None (-> hash fallback) for
+    replicas whose rank is stale or unreported."""
+    from ..observability import federation as _fed
+
+    def feed(replica):
+        values = _fed.cluster_values("mxtpu_serving_queue_depth")
+        rank = rank_of(replica)
+        return values.get(rank)
+
+    return feed
+
+
+class ReplicaRouter:
+    """Dispatch requests across live replicas; see module docstring."""
+
+    #: vnodes per replica on the hash ring — enough that one death
+    #: reshuffles ~1/n of keyspace, not half of it
+    _VNODES = 32
+
+    def __init__(self, candidates_fn, model="model", *, retries=None,
+                 hedge_ms=None, depth_feed=None, on_death=None,
+                 fresh_depth_s=5.0):
+        self._candidates = candidates_fn  # () -> ordered live replicas
+        self._model = str(model)
+        self._retries = fleet_retries() if retries is None else int(retries)
+        self._hedge_ms = fleet_hedge_ms() if hedge_ms is None \
+            else float(hedge_ms)
+        self._depth_feed = depth_feed
+        self._on_death = on_death
+        self._fresh_depth_s = float(fresh_depth_s)
+        self._rng = random.Random()  # placement tie-break only, not crypto
+        self._lat_lock = threading.Lock()
+        self._latencies = deque(maxlen=512)  # seconds, completed requests
+        self._GUARDED_BY = {"_latencies": "_lat_lock"}
+
+    # -- candidate ordering ------------------------------------------------
+    def _depth_of(self, replica):
+        """Freshest known queue depth, or None when no fresh signal."""
+        if self._depth_feed is not None:
+            try:
+                d = self._depth_feed(replica)
+            except Exception:
+                d = None
+            if d is not None:
+                return float(d)
+        if replica.depth_age() <= self._fresh_depth_s:
+            return float(replica.queue_depth())
+        return None
+
+    def _hash_order(self, replicas, key):
+        """Consistent-hash ring walk from the key's point; ``key=None``
+        degrades to a uniform shuffle (stateless spread)."""
+        if key is None:
+            order = list(replicas)
+            self._rng.shuffle(order)
+            return order
+        ring = []
+        for r in replicas:
+            for v in range(self._VNODES):
+                h = hashlib.md5(f"{r.uid}:{v}".encode()).digest()
+                ring.append((h, r))
+        ring.sort(key=lambda t: t[0])
+        point = hashlib.md5(str(key).encode()).digest()
+        order, seen = [], set()
+        start = 0
+        while start < len(ring) and ring[start][0] < point:
+            start += 1
+        for i in range(len(ring)):
+            r = ring[(start + i) % len(ring)][1]
+            if r.uid not in seen:
+                seen.add(r.uid)
+                order.append(r)
+        return order
+
+    def _order(self, key, tried):
+        """Candidates for the next dispatch: fresh-depth replicas first
+        (ascending depth), signal-less ones after in ring order."""
+        live = [r for r in self._candidates() if r.uid not in tried]
+        scored, unknown = [], []
+        for r in live:
+            d = self._depth_of(r)
+            (unknown if d is None else scored).append((d, r))
+        scored.sort(key=lambda t: (t[0], t[1].uid))
+        ordered = [r for _, r in scored]
+        ordered += self._hash_order([r for _, r in unknown], key)
+        return ordered
+
+    # -- dispatch ----------------------------------------------------------
+    def _note_death(self, replica, error):
+        reason = "dead" if isinstance(error, ReplicaDead) else "closed"
+        if _obs.ENABLED:
+            _obs.FLEET_RETRY_TOTAL.inc(1, model=self._model, reason=reason)
+        if self._on_death is not None:
+            try:
+                self._on_death(replica, error)
+            except Exception:
+                pass
+
+    def _dispatch_once(self, x, kwargs, key, tried):
+        """One placement round: try candidates in order until ONE
+        accepts the request (at most one dispatch per call). Raises
+        ReplicaLost when no candidate accepts."""
+        budget = None if self._retries <= 0 else self._retries + 1
+        for replica in self._order(key, tried):
+            if budget is not None and len(tried) >= budget:
+                break
+            tried.add(replica.uid)
+            try:
+                inner = replica.submit(x, **kwargs)
+            except DEATH_ERRORS as e:
+                self._note_death(replica, e)
+                continue
+            if _obs.ENABLED:
+                _obs.FLEET_DISPATCH_TOTAL.inc(
+                    1, model=self._model, replica=str(replica.index))
+            return replica, inner
+        if _obs.ENABLED:
+            _obs.FLEET_REPLICA_LOST_TOTAL.inc(1, model=self._model)
+        raise ReplicaLost(
+            f"model {self._model!r}: all {len(tried)} candidate "
+            "replica(s) failed with replica-death errors — no survivor "
+            "accepted the request")
+
+    def submit(self, x, key=None, **kwargs):
+        """Dispatch one request; returns a :class:`FleetFuture` whose
+        ``result()`` transparently fails over on replica death."""
+        tried = set()
+        replica, inner = self._dispatch_once(x, kwargs, key, tried)
+        return FleetFuture(self, replica, inner, x, kwargs, key, tried)
+
+    # -- latency window ----------------------------------------------------
+    def record_latency(self, seconds):
+        with self._lat_lock:
+            self._latencies.append(float(seconds))
+
+    def p99_ms(self):
+        """p99 over the sliding completed-request window (None until
+        enough samples) — the autoscaler's SLO signal."""
+        with self._lat_lock:
+            lat = sorted(self._latencies)
+        if len(lat) < 5:
+            return None
+        return lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1000.0
+
+    def latency_count(self) -> int:
+        with self._lat_lock:
+            return len(self._latencies)
+
+
+class FleetFuture:
+    """A request's fleet-level handle: waits on the current replica's
+    future and re-dispatches (at-most-once per replica) when the
+    replica dies underneath it. ``result()`` therefore raises
+    :class:`ReplicaLost` only when every candidate has failed — and
+    the request's OWN typed outcomes (timeout, cancel, shed) pass
+    through unchanged."""
+
+    _POLL_S = 0.002  # hedge-mode completion poll slice
+
+    def __init__(self, router, replica, inner, x, kwargs, key, tried):
+        self._router = router
+        self._replica = replica
+        self._inner = inner
+        self._x = x
+        self._kwargs = kwargs
+        self._key = key
+        self._tried = tried
+        self._hedge = None       # (replica, inner) once hedged
+        self._hedged = False
+        self._t0 = time.monotonic()
+
+    @property
+    def replica(self):
+        return self._replica
+
+    @property
+    def version(self):
+        return getattr(self._inner, "version", None)
+
+    def done(self) -> bool:
+        if self._inner.done():
+            return True
+        return self._hedge is not None and self._hedge[1].done()
+
+    def tried_count(self) -> int:
+        return len(self._tried)
+
+    def _reap(self, fut, deadline):
+        """Resolve one inner future within the deadline; DEATH_ERRORS
+        propagate for failover, other outcomes are final."""
+        remaining = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        return fut.result(remaining)
+
+    def _hedge_wait(self, deadline):
+        """Wait with a duplicate dispatch after the hedge budget; first
+        terminal future wins, dead branches fail over."""
+        hedge_s = self._router._hedge_ms / 1000.0
+        if self._hedge is None:
+            hedge_at = self._t0 + hedge_s
+            while time.monotonic() < hedge_at:
+                if self._inner.done():
+                    return self._reap(self._inner, deadline)
+                if deadline is not None and time.monotonic() >= deadline:
+                    return self._reap(self._inner, deadline)  # raises
+                time.sleep(self._POLL_S)
+            try:
+                self._hedge = self._router._dispatch_once(
+                    self._x, self._kwargs, self._key, self._tried)
+                self._hedged = True
+                if _obs.ENABLED:
+                    _obs.FLEET_HEDGED_TOTAL.inc(1, model=self._router._model)
+            except ReplicaLost:
+                self._hedge = None  # nobody left to hedge onto: primary only
+                return self._reap(self._inner, deadline)
+        # poll both branches; first terminal result wins
+        while True:
+            for fut in (self._inner, self._hedge[1]):
+                if fut.done():
+                    try:
+                        return fut.result(0)
+                    except DEATH_ERRORS:
+                        if fut is self._inner:
+                            # primary died: promote the hedge
+                            self._router._note_death(
+                                self._replica, ReplicaDead("hedge primary"))
+                            self._replica, self._inner = self._hedge
+                            self._hedge = None
+                            return self._reap(self._inner, deadline)
+                        self._hedge = None  # hedge died: primary only
+                        return self._reap(self._inner, deadline)
+            if deadline is not None and time.monotonic() >= deadline:
+                return self._reap(self._inner, deadline)  # raises Timeout
+            time.sleep(self._POLL_S)
+
+    def _await_once(self, deadline):
+        if self._router._hedge_ms > 0:
+            return self._hedge_wait(deadline)
+        return self._reap(self._inner, deadline)
+
+    def result(self, timeout=None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        attempt = 0
+        while True:
+            try:
+                out = self._await_once(deadline)
+            except DEATH_ERRORS as e:
+                self._router._note_death(self._replica, e)
+                attempt += 1
+                delay = backoff_delays(2, 0.001, max_delay=0.05)[0]
+                time.sleep(delay)
+                # re-dispatch onto the next candidate (at-most-once set
+                # carries over, so dead replicas stay burned)
+                self._replica, self._inner = self._router._dispatch_once(
+                    self._x, self._kwargs, self._key, self._tried)
+                continue
+            self._router.record_latency(time.monotonic() - self._t0)
+            return out
+
+    def was_hedged(self) -> bool:
+        return self._hedged

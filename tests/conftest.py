@@ -60,6 +60,11 @@ def pytest_configure(config):
         "dist_baseline: known-environmental distributed multiprocess "
         "failures (launcher-spawned workers need real multi-core); "
         "diff tier-1 results against this set, not against zero")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tests (process-replica spawn/compile, "
+        "multi-second chaos drills) — excluded from tier-1 via "
+        "`-m 'not slow'`")
 
 
 @pytest.fixture(autouse=True)

@@ -36,6 +36,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR11_OUT"] = str(tmp_path / "BENCH_pr11.json")
     env["BENCH_PR13_OUT"] = str(tmp_path / "BENCH_pr13.json")
     env["BENCH_PR15_OUT"] = str(tmp_path / "BENCH_pr15.json")
+    env["BENCH_PR17_OUT"] = str(tmp_path / "BENCH_pr17.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -78,6 +79,11 @@ def _train_fused_rec(recs):
     return tf[0] if tf else None
 
 
+def _fleet_rec(recs):
+    fl = [r for r in recs if r["metric"].startswith("fleet_recovery")]
+    return fl[0] if fl else None
+
+
 #: the shared BENCH_ONLY re-run contract: a timing/pressure-sensitive
 #: assert that fails during the FULL run gets exactly one clean-
 #: subprocess retry of JUST its scenario (host pressure across a 10-
@@ -93,6 +99,7 @@ _STANDALONE = {
     "elastic": (_elastic_rec, ("BENCH_PR11_OUT",)),
     "serving": (_serving_rec, ("BENCH_PR13_OUT",)),
     "federation": (_federation_rec, ("BENCH_PR15_OUT",)),
+    "fleet": (_fleet_rec, ("BENCH_PR17_OUT",)),
 }
 
 
@@ -275,6 +282,64 @@ def test_bench_emits_driver_contract(tmp_path):
     assert not verdict["pass"] and any(
         f["key"] == "steps_per_sec_federated"
         for f in verdict["failures"]), verdict
+    # self-healing fleet scenario (PR17): chaos SIGKILLs a replica
+    # process mid-traffic. The robustness gates are HARD: the kill
+    # fired, ZERO requests hung, every in-flight request was retried or
+    # failed typed, zero stale-version responses across the concurrent
+    # staged swap, the autoscaler replaced the replica, and the burst
+    # shed strictly by priority class (critical NEVER policy-shed).
+    # p99-back-in-SLO rides recovery timing — the pressure-sensitive
+    # pair gets the standalone retry.
+    fl = _fleet_rec(recs)
+    assert fl, names
+    assert fl["kill_injected"] is True, fl
+    assert fl["hung_requests"] == 0, fl
+    assert fl["stale_version_responses"] == 0, fl
+    assert fl["shed_critical"] == 0, fl
+    assert fl["priority_shed_ok"] is True, fl
+    assert fl["shed_bulk"] > 0, fl
+    assert fl["replaced"] >= 1, fl
+    assert fl["inflight_ok"] + fl["inflight_typed_failed"] > 0, fl
+    pr17_path = env["BENCH_PR17_OUT"]
+    base17 = json.load(open(os.path.join(ROOT, "BENCH_pr17.json")))
+    lim = base17["recovery_s"] * 1.9  # the diff gate's lower-better band
+    if not (fl["p99_in_slo"] is True and 0.0 <= fl["value"] <= lim):
+        fl, res2 = _rerun_standalone(env, "fleet")
+        assert fl and fl["p99_in_slo"] is True \
+            and 0.0 <= fl["value"] <= lim \
+            and fl["hung_requests"] == 0 \
+            and fl["stale_version_responses"] == 0, \
+            (fl, res.stderr[-1000:], res2.stderr[-1000:])
+        pr17_path += ".retry"  # gate the clean re-run, not the noisy one
+    pr17 = json.load(open(pr17_path))
+    assert pr17["scenario"] == "fleet" \
+        and pr17["hung_requests"] == 0 \
+        and pr17["stale_version_responses"] == 0 \
+        and pr17["priority_shed_ok"], pr17
+    # the committed BENCH_pr17.json baseline gates the record: the
+    # fresh run passes at a wide band (recovery_s is lower-is-better;
+    # p99_in_slo / priority_shed_ok are exact booleans), and a doctored
+    # copy that flips the in-SLO contract FAILS (the gate gates)
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   pr17_path, os.path.join(ROOT, "BENCH_pr17.json"),
+                   "--tolerance", "0.9", "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 0, (diff.stdout, diff.stderr)
+    verdict = json.loads(diff.stdout)
+    assert verdict["pass"] and verdict["checked"] > 0, verdict
+    doctored = dict(pr17)
+    doctored["p99_in_slo"] = False
+    doc_path = tmp_path / "BENCH_pr17_doctored.json"
+    doc_path.write_text(json.dumps(doctored))
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   str(doc_path), pr17_path, "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 1, (diff.returncode, diff.stdout)
+    verdict = json.loads(diff.stdout)
+    assert not verdict["pass"] and any(
+        f["key"] == "p99_in_slo" for f in verdict["failures"]), verdict
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs
@@ -290,6 +355,7 @@ def test_bench_emits_driver_contract(tmp_path):
     assert status["rc"] == 0, status
     assert "amp" in status["completed"] and "superstep" in \
         status["completed"] and "elastic" in status["completed"] \
+        and "fleet" in status["completed"] \
         and not status["failed"], status
     # MFU accounting contract (PR7): EVERY row carries flops_per_step
     # and mfu; a null always pairs with a reason (this CPU smoke has no
@@ -407,6 +473,11 @@ def test_bench_diff_direction_classification():
     assert bd.direction("train_throughput") == "higher"
     # latency stays lower-is-better; unknown names stay symmetric
     assert bd.direction("step_time_p99_ms") == "lower"
+    # PR17 fleet gate: recovery is wall time (lower), shed counts have
+    # no inherent direction (gated by the priority_shed_ok boolean)
+    assert bd.direction("recovery_s") == "lower"
+    assert bd.direction("stale_version_responses") == "lower"
+    assert bd.direction("shed_bulk") == "both"
     assert bd.direction("some_novel_metric") == "both"
     # unit classification still takes precedence over the name
     assert bd.direction("weird_name", unit="img/s") == "higher"

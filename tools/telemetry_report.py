@@ -240,6 +240,39 @@ def render_serving(events):
     return "\n".join(lines)
 
 
+def render_fleet(events):
+    """Self-healing fleet summary from the ``fleet.*`` trace instants:
+    ``fleet.brownout`` level transitions (``args``: model/level/prev)
+    and ``fleet.autoscale`` actuations (``args``: model/action/n).
+    Crash-proof like the serving section: absent series -> empty
+    string, malformed args render as '-' / count as zero."""
+    brownouts = [ev for ev in events
+                 if ev.get("name") == "fleet.brownout"]
+    actuations = [ev for ev in events
+                  if ev.get("name") == "fleet.autoscale"]
+    if not (brownouts or actuations):
+        return ""
+
+    def arg(ev, key):
+        args = ev.get("args")
+        return args.get(key, "-") if isinstance(args, dict) else "-"
+
+    lines = ["", "Fleet:"]
+    per_action = {}
+    for ev in actuations:
+        k = (str(arg(ev, "model")), str(arg(ev, "action")))
+        per_action[k] = per_action.get(k, 0) + 1
+    for (model, action) in sorted(per_action):
+        lines.append(
+            f"  autoscale [{model}] {action}: "
+            f"{per_action[(model, action)]}")
+    for ev in brownouts:
+        lines.append(
+            f"  brownout [{arg(ev, 'model')}] level "
+            f"{arg(ev, 'prev')} -> {arg(ev, 'level')}")
+    return "\n".join(lines)
+
+
 #: the attribution plane's phase order (observability/attribution.py)
 _PHASES = ("input_wait", "h2d", "ckpt_overhead", "comm_exposed",
            "compute", "host_gap")
@@ -538,6 +571,9 @@ def main(argv=None):
     serving = render_serving(events)
     if serving:
         print(serving)
+    fleet = render_fleet(events)
+    if fleet:
+        print(fleet)
     cl = render_cluster(cluster)
     if cl:
         print(cl)
