@@ -555,6 +555,54 @@ FLEET_RECOVERY_SECONDS = _REGISTRY.gauge(
     "replacement replica serving again, by model — the chaos "
     "certification budget in bench.py fleet")
 
+# -- autoregressive decode fast path (serving/generation.py, kvcache.py) ---
+
+DECODE_TOKENS_TOTAL = _REGISTRY.counter(
+    "mxtpu_decode_tokens_total",
+    "tokens generated (prefill first-tokens + decode-chunk emissions), "
+    "by model — with mxtpu_decode_chunks_total this is the "
+    "dispatches-per-token certification pair")
+DECODE_CHUNKS_TOTAL = _REGISTRY.counter(
+    "mxtpu_decode_chunks_total",
+    "single-dispatch decode-chunk executions (each advances EVERY "
+    "active slot up to MXTPU_DECODE_CHUNK tokens in one XLA dispatch), "
+    "by model")
+DECODE_ITL_SECONDS = _REGISTRY.histogram(
+    "mxtpu_decode_inter_token_seconds",
+    "amortized inter-token latency: decode-chunk wall time / tokens the "
+    "slot emitted in that chunk (tokens of one chunk arrive together), "
+    "by model — p50/p99 are the bench's ITL baselines",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25))
+DECODE_PREFILL_SECONDS = _REGISTRY.histogram(
+    "mxtpu_decode_prefill_seconds",
+    "prompt-ingestion dispatch wall time (per-bucket prefill executable "
+    "+ first-token sample), by model — the join cost of token-level "
+    "continuous batching")
+DECODE_ACTIVE_SLOTS = _REGISTRY.gauge(
+    "mxtpu_decode_active_slots",
+    "decode-batch slots holding a live sequence (of MXTPU_DECODE_SLOTS), "
+    "by model — sustained low fill under queue depth means prompts are "
+    "stuck on cache admission (see mxtpu_kvcache_occupancy_ratio)")
+KVCACHE_BLOCKS_USED = _REGISTRY.gauge(
+    "mxtpu_kvcache_blocks_used",
+    "paged KV cache blocks currently allocated (of the usable pool — "
+    "block 0 is the reserved null sink), by model")
+KVCACHE_OCCUPANCY = _REGISTRY.gauge(
+    "mxtpu_kvcache_occupancy_ratio",
+    "allocated fraction of the usable KV block pool, by model — near "
+    "1.0 admission starts shedding (mxtpu_kvcache_oom_total) and "
+    "MXTPU_KVCACHE_BLOCKS needs raising")
+KVCACHE_FORKS_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvcache_forks_total",
+    "block-table forks (shared-prefix refcount bumps; copy-on-write "
+    "copies exactly one block on first divergent append), by model")
+KVCACHE_OOM_TOTAL = _REGISTRY.counter(
+    "mxtpu_kvcache_oom_total",
+    "block allocations refused because the pool was exhausted (typed "
+    "KVCacheOOM — admission backpressure or early retirement, never a "
+    "partially-backed sequence), by model")
+
 
 # ---------------------------------------------------------------------------
 # hot-path record helpers (called only after an ENABLED check at the site)

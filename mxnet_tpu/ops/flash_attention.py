@@ -684,6 +684,163 @@ def _flash_bwd_rule(scale, causal, block_size, window, native_gqa, res, g):
 flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# paged decode attention: batch=many, q_len=1, K/V via block-table
+# indirection (the serving fast path — vLLM/PagedAttention shape)
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale, bs, mb, kvh):
+    """One grid step per (sequence-band, kv block): grid
+    ``(B * KVH, max_blocks)``. The block tables and context lengths ride
+    the scalar-prefetch lane, so each step's K/V DMA source address is
+    ``tables[seq, j]`` — the pool block — and Mosaic double-buffers the
+    NEXT block's fetch against THIS block's compute (the explicit DMA
+    overlap the decode band structure exists for). Online softmax in
+    fp32 VMEM scratch, exactly the prefill kernel's recurrence with
+    q_len = group (the GQA query heads of one kv head)."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    seq = i // kvh
+    ctx = lens_ref[seq]
+    col0 = j * bs
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(col0 < ctx)
+    def _compute():
+        q = q_ref[0, 0]                                  # (group, d)
+        k = k_ref[0, :, 0, :]                            # (bs, d)
+        v = v_ref[0, :, 0, :]                            # (bs, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + col0
+        s = jnp.where(cols < ctx, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+
+    @pl.when(j == mb - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _pallas_paged_decode(q, k_pool, v_pool, tables, lens, scale):
+    B, H, D = q.shape
+    _, bs, KVH, _ = k_pool.shape
+    mb = tables.shape[1]
+    group = H // KVH
+    qr = q.reshape(B, KVH, group, D)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * KVH, mb),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D),
+                         lambda i, j, tables, lens, _kvh=KVH:
+                         (i // _kvh, i % _kvh, 0, 0)),
+            # the indirection: this grid step's K/V block is whichever
+            # POOL block the sequence's table names for logical block j
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda i, j, tables, lens, _kvh=KVH:
+                         (tables[i // _kvh, j], 0, i % _kvh, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda i, j, tables, lens, _kvh=KVH:
+                         (tables[i // _kvh, j], 0, i % _kvh, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D),
+                               lambda i, j, tables, lens, _kvh=KVH:
+                               (i // _kvh, i % _kvh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale, bs=bs, mb=mb,
+                          kvh=KVH),
+        out_shape=jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+        grid_spec=grid_spec,
+    )(tables, lens, qr, k_pool, v_pool)
+    return out.reshape(B, H, D)
+
+
+def _jnp_paged_decode(q, k_pool, v_pool, tables, lens, scale):
+    """CPU path + oracle: materialize each slot's context via the same
+    table gather the kernel's index map performs, then masked softmax."""
+    B, H, D = q.shape
+    _, bs, KVH, _ = k_pool.shape
+    mb = tables.shape[1]
+    S = mb * bs
+    k = k_pool[tables].reshape(B, S, KVH, D)
+    v = v_pool[tables].reshape(B, S, KVH, D)
+    group = H // KVH
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.arange(S, dtype=jnp.int32)[None, :] < lens[:, None]
+    s = jnp.where(mask[:, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    out = jnp.einsum("bhs,bshd->bhd", p / l, v.astype(jnp.float32))
+    # fully-masked rows (empty / inactive slots) produce zeros, not the
+    # uniform-weights garbage a raw softmax would
+    out = jnp.where((lens > 0)[:, None, None], out, 0.0)
+    return out.astype(q.dtype)
+
+
+@register("paged_decode_attention")
+def paged_decode_attention(query, k_pool, v_pool, block_tables,
+                           context_lens, scale=None):
+    """Decode-specialized attention: ``query`` is one new token per
+    sequence, ``(B, H, D)``; K/V live in ONE layer's slice of the paged
+    pool, ``(num_blocks, block_size, KVH, D)``; ``block_tables``
+    ``(B, max_blocks)`` int32 names each sequence's pool blocks in
+    logical order and ``context_lens`` ``(B,)`` int32 is how many
+    positions are valid (rows past it — padding and the null block —
+    are masked).
+
+    TPU path: one grid step per (sequence-band, kv block) with the
+    tables/lengths scalar-prefetched so the index map itself performs
+    the block indirection and Mosaic overlaps the next block's DMA with
+    the current block's compute (``PrefetchScalarGridSpec``). GQA is
+    native: the band is a kv head, its ``H/KVH`` query heads form the
+    q-block rows, so each K/V block is fetched once per group. CPU/
+    debug path: the same math via a plain gather (the test oracle).
+
+    Sequences with ``context_lens == 0`` (empty batch slots) return
+    zeros. Grows O(1) per generated token — no T×S score matrix, no
+    cache reshuffling as sequences grow (allocation is the host-side
+    free list in :mod:`mxnet_tpu.serving.kvcache`)."""
+    if scale is None:
+        scale = 1.0 / (query.shape[-1] ** 0.5)
+    if query.shape[1] % k_pool.shape[2] != 0:
+        raise ValueError("query heads must be a multiple of kv heads; got "
+                         f"{query.shape[1]} vs {k_pool.shape[2]}")
+    tables = block_tables.astype(jnp.int32)
+    lens = context_lens.astype(jnp.int32)
+    if _HAS_PALLAS and _use_pallas(query.shape[-1]):
+        return _pallas_paged_decode(query, k_pool, v_pool, tables, lens,
+                                    float(scale))
+    return _jnp_paged_decode(query, k_pool, v_pool, tables, lens,
+                             float(scale))
+
+
 @register("flash_attention", aliases=("_contrib_flash_attention",))
 def flash_attention(query, key, value, scale=None, causal=False,
                     block_size=1024, window=0, native_gqa=False):

@@ -16,6 +16,12 @@ primitives:
   batch-fill, queue depth, shed/timeout counters — scrapeable via
   ``observability.serve_metrics``; ``tools/telemetry_report.py`` has a
   Serving section);
+- the autoregressive decode fast path — :class:`GenerationEngine`
+  (token-level continuous batching: one sealed chunk-of-T decode
+  executable with on-device sampling; requests join/leave between
+  chunks) over :class:`PagedKVCache` (block-table paged K/V pool with
+  free-list allocation and copy-on-fork shared prefixes), with
+  :class:`TransformerDecoderLM` as the reference decode-capable net;
 - the self-healing fleet layer — :class:`ServingFleet` /
   :class:`ReplicaSet` (replicas across processes/hosts behind one
   :class:`ReplicaRouter` with least-queue-depth dispatch, typed
@@ -42,6 +48,7 @@ from .engine import (  # noqa: F401
 from .errors import (  # noqa: F401
     BrownoutShed,
     EngineClosed,
+    KVCacheOOM,
     ReplicaDead,
     ReplicaLost,
     RequestCancelled,
@@ -51,6 +58,21 @@ from .errors import (  # noqa: F401
     ServerOverloaded,
     ServingError,
     StagedLoadError,
+)
+from .kvcache import (  # noqa: F401
+    BlockTable,
+    PagedKVCache,
+    kvcache_block_size,
+    kvcache_blocks,
+)
+from .decoder import TransformerDecoderLM  # noqa: F401
+from .generation import (  # noqa: F401
+    GenerateFuture,
+    GenerationEngine,
+    decode_chunk,
+    decode_max_new,
+    decode_slots,
+    sample_tokens,
 )
 from .repository import ModelRepository  # noqa: F401
 from .replica import LocalReplica, ProcessReplica  # noqa: F401

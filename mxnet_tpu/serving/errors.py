@@ -72,6 +72,16 @@ class ReplicaLost(ServingError):
     a single host kill never surfaces this while a survivor exists."""
 
 
+class KVCacheOOM(ServerOverloaded):
+    """The paged KV cache's block pool could not supply the blocks a
+    generation request needs (admission reservation or mid-decode
+    growth). Subclasses :class:`ServerOverloaded` — the request was
+    refused (or retired early with the tokens produced so far), never
+    left holding a partially-backed cache; the client should retry
+    after other sequences complete or the pool is resized
+    (``MXTPU_KVCACHE_BLOCKS``)."""
+
+
 class BrownoutShed(ServerOverloaded):
     """Degraded-mode load shed: the fleet's latched brownout state
     machine refused this request's priority class (``bulk`` sheds

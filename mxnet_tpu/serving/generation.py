@@ -1,0 +1,938 @@
+"""Autoregressive decode fast path: single-dispatch chunked decode,
+on-device sampling, and token-level continuous batching.
+
+The one-shot :class:`~.engine.InferenceEngine` answers a request with
+one dispatch; a generative request is HUNDREDS of sequential steps, so
+the host round trip per token — not the math — dominates. This engine
+removes it at three levels:
+
+- **one executable for the whole decode batch**: a ``lax.scan`` over
+  ``MXTPU_DECODE_CHUNK`` steps (model step + sampling + EOS/budget
+  bookkeeping all in-graph) is AOT-compiled ONCE at deploy for a fixed
+  slot count, so the host touches the loop once per chunk —
+  amortized XLA dispatches per generated token are ``<= 1/chunk``
+  (the bench certifies this with a PR-6-style dispatch-count assert);
+- **on-device sampling** (:func:`sample_tokens`): greedy / temperature
+  / top-k / top-p per SLOT (every request carries its own knobs as
+  operands, so mixed sampling policies share one executable), PRNG
+  keys folded and threaded device-side — no sync to pick a token;
+- **token-level continuous batching** (Orca-style iteration-level
+  scheduling): the decode batch is ``MXTPU_DECODE_SLOTS`` slots;
+  requests JOIN an idle slot between chunks (prefill is its own
+  per-prompt-bucket executable) and LEAVE the moment EOS or their
+  token budget retires them — a late submit never waits for the
+  running batch to drain, and a finished sequence never pads it.
+
+K/V state lives in the :class:`~.kvcache.PagedKVCache` block pool;
+the pools are DONATED through every prefill/decode dispatch, so cache
+memory is constant and aliased in place. Slot liveness is an operand
+(never a shape): ragged traffic — joins, retirements, wildly different
+lengths — reuses the same sealed executables with ZERO retraces after
+warmup (``RetraceForbidden`` otherwise, the PR-13 contract).
+
+Sampling reproducibility: a request's first token is drawn from its
+own ``seed`` (folded in-graph), so prefill is per-request
+deterministic; subsequent tokens draw from the engine's device-side
+key stream, which advances per CHUNK — deterministic for a fixed
+admission order. ``greedy=True`` (the default) is always bit-stable.
+
+Served through :class:`~.repository.ModelRepository` and the PR-17
+fleet unchanged: ``submit()/predict()/stats()/queue_depth()`` plus the
+pause/resume/kill/close lifecycle mirror ``InferenceEngine``, and
+``repo.load`` picks this engine automatically for nets exposing
+``decode_step_fn`` (e.g. :class:`~.decoder.TransformerDecoderLM`).
+
+Knobs: ``MXTPU_DECODE_SLOTS`` / ``MXTPU_DECODE_CHUNK`` /
+``MXTPU_DECODE_MAX_NEW`` (docs/env_vars.md); metrics:
+``mxtpu_decode_*`` + ``mxtpu_kvcache_*`` (docs/observability.md);
+recipe: docs/serving.md "Generation".
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as _np
+
+from .. import base
+from .. import observability as _obs
+from ..base import MXNetError
+from .engine import serve_queue_cap
+from .errors import (
+    EngineClosed,
+    KVCacheOOM,
+    ReplicaDead,
+    RequestCancelled,
+    RequestTimeout,
+    RetraceForbidden,
+    ServerOverloaded,
+    ServingError,
+)
+from .kvcache import PagedKVCache
+
+_SLOTS_DEFAULT = 8
+_CHUNK_DEFAULT = 8
+_MAX_NEW_DEFAULT = 32
+
+
+def decode_slots() -> int:
+    """Decode-batch width in slots (``MXTPU_DECODE_SLOTS``, default 8).
+    ONE decode executable is compiled for exactly this many slots;
+    requests join/leave between chunks. More slots = more concurrent
+    sequences per dispatch (throughput) at more pool pressure."""
+    return max(1, base.getenv("MXTPU_DECODE_SLOTS", _SLOTS_DEFAULT,
+                              dtype=int))
+
+
+def decode_chunk() -> int:
+    """Decode steps fused per dispatch (``MXTPU_DECODE_CHUNK``, default
+    8) — the ``lax.scan`` length. Raising it amortizes the host round
+    trip over more tokens (dispatches/token = 1/chunk) but delays
+    join/retire scheduling to chunk boundaries; the serving analog of
+    ``MXTPU_SUPERSTEP_K``."""
+    return max(1, base.getenv("MXTPU_DECODE_CHUNK", _CHUNK_DEFAULT,
+                              dtype=int))
+
+
+def decode_max_new() -> int:
+    """Default per-request new-token budget when ``submit`` doesn't
+    pass ``max_new_tokens`` (``MXTPU_DECODE_MAX_NEW``, default 32)."""
+    return max(1, base.getenv("MXTPU_DECODE_MAX_NEW", _MAX_NEW_DEFAULT,
+                              dtype=int))
+
+
+# ---------------------------------------------------------------------------
+# on-device sampling
+# ---------------------------------------------------------------------------
+
+def sample_tokens(logits, key, temperature, top_k, top_p, greedy):
+    """Sample one token per row, entirely in-graph. ``logits`` is
+    ``(B, V)``; every knob is a ``(B,)`` vector so each batch slot
+    applies ITS OWN policy inside the shared executable:
+
+    - ``greedy`` (bool): argmax of the raw logits (ignores the rest);
+    - ``temperature`` (f32): logit scale before filtering;
+    - ``top_k`` (i32): keep the k highest-scoring tokens (0 = off);
+    - ``top_p`` (f32): nucleus — keep the smallest prefix of the
+      sorted distribution with cumulative probability >= p (1.0 = off;
+      the argmax always survives, so filtering can never empty a row).
+
+    Filters compose (top-k first, then top-p) by masking to ``-inf``
+    and drawing with ``jax.random.categorical``."""
+    import jax
+    import jax.numpy as jnp
+
+    v = logits.shape[-1]
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    kk = jnp.where(top_k > 0, jnp.clip(top_k, 1, v), v)
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
+    limited = jnp.where(scaled < kth, -jnp.inf, scaled)
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    mass_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = mass_before < top_p[:, None]
+    thresh = jnp.min(jnp.where(keep, sorted_desc, jnp.inf), axis=-1,
+                     keepdims=True)
+    limited = jnp.where(scaled < thresh, -jnp.inf, limited)
+    drawn = jax.random.categorical(key, limited, axis=-1)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1),
+                     drawn).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# request/future plumbing (mirrors batcher._Request / ServeFuture)
+# ---------------------------------------------------------------------------
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "temperature", "top_k", "top_p",
+                 "greedy", "seed", "eos", "deadline", "t_submit",
+                 "tokens", "t_first", "t_last", "event", "result",
+                 "error", "version", "claimed", "cancelled",
+                 "_state_lock")
+
+    def __init__(self, prompt, max_new, temperature, top_k, top_p,
+                 greedy, seed, eos, deadline):
+        self.prompt = prompt
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.greedy = bool(greedy)
+        self.seed = int(seed)
+        self.eos = int(eos)
+        self.deadline = deadline  # absolute perf_counter time, or None
+        self.t_submit = time.perf_counter()
+        self.tokens = []
+        self.t_first = None
+        self.t_last = None
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.version = None
+        self.claimed = False     # admission won the CAS
+        self.cancelled = False
+        self._state_lock = threading.Lock()
+
+    def claim(self) -> bool:
+        """Admission-side CAS: exactly one of {admit, cancel} wins."""
+        with self._state_lock:
+            if self.cancelled:
+                return False
+            self.claimed = True
+            return True
+
+    def cancel(self) -> bool:
+        with self._state_lock:
+            if self.claimed or self.event.is_set():
+                return False
+            self.cancelled = True
+        self.error = RequestCancelled(
+            "generation request cancelled while queued — never admitted")
+        self.event.set()
+        return True
+
+    def finish(self, result=None, error=None, version=None):
+        if self.event.is_set():
+            return
+        self.result = result
+        self.error = error
+        self.version = version
+        self.event.set()
+
+
+class GenerateFuture:
+    """Client handle for a generation request. ``result()`` returns the
+    generated token ids as ``np.int32`` (prompt NOT included; the EOS
+    token, when hit, IS the last element)."""
+
+    def __init__(self, req: _GenRequest):
+        self._req = req
+
+    def done(self) -> bool:
+        return self._req.event.is_set()
+
+    @property
+    def version(self):
+        return self._req.version
+
+    def cancel(self) -> bool:
+        """Withdraw a still-queued request (True iff it was never
+        admitted to a slot — after admission the generation runs to
+        completion and the original outcome stands)."""
+        return self._req.cancel()
+
+    def cancelled(self) -> bool:
+        return self._req.cancelled
+
+    def result(self, timeout=None):
+        if not self._req.event.wait(timeout):
+            raise TimeoutError(
+                f"generation result not ready within {timeout}s (the "
+                "request itself is still running; cancel() to withdraw "
+                "a queued one)")
+        if self._req.error is not None:
+            raise self._req.error
+        return self._req.result
+
+    def token_times(self):
+        """(t_first_token, t_last_token) perf_counter stamps — the
+        bench's ITL source (None until the request finishes)."""
+        return self._req.t_first, self._req.t_last
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class GenerationEngine:
+    """Continuous-batching generation server over a paged KV cache.
+
+    ``shapes`` are PROMPT-LENGTH buckets (ints, or 1-tuples): each gets
+    its own sealed prefill executable; the decode loop is ONE sealed
+    executable for ``slots`` concurrent sequences regardless of length.
+
+    >>> net = TransformerDecoderLM(vocab_size=64)
+    >>> eng = GenerationEngine(net, [8, 16], slots=4, chunk=4)
+    >>> toks = eng.predict(np.array([5, 3, 9]), max_new_tokens=12)
+
+    Drop-in for the repository/fleet: same submit/predict/stats/
+    lifecycle surface as :class:`InferenceEngine`."""
+
+    # machine-checked lock protocol (mxtpu-lint thread-guard rule)
+    _GUARDED_BY = {
+        "_queue": "_lock",
+        "_closing": "_lock",
+        "_killed": "_lock",
+        "_paused": "_lock",
+    }
+
+    def __init__(self, net, shapes, *, slots=None, chunk=None,
+                 queue_cap=None, cache_blocks=None, cache_block_size=None,
+                 max_new_default=None, seed=0, name="model", version="v1",
+                 autostart=True, ctx=None, dtype=None):
+        for attr in ("decode_step_fn", "prefill_fn", "params",
+                     "decode_dims"):
+            if not hasattr(net, attr):
+                raise MXNetError(
+                    f"{type(net).__name__} has no {attr} — generation "
+                    "needs a decode-capable net (e.g. "
+                    "serving.TransformerDecoderLM)")
+        self._name = str(name)
+        self._version = str(version)
+        self._net = net
+        dims = net.decode_dims()
+        self.max_seq = int(dims["max_seq"])
+        self.vocab_size = int(dims["vocab_size"])
+        self._slots = int(slots) if slots is not None else decode_slots()
+        self._chunk = int(chunk) if chunk is not None else decode_chunk()
+        self._max_new_default = (int(max_new_default) if max_new_default
+                                 is not None else decode_max_new())
+        self._queue_cap = (int(queue_cap) if queue_cap is not None
+                           else serve_queue_cap())
+        self._buckets = self._normalize_buckets(shapes)
+        self.cache = PagedKVCache(
+            dims["layers"], dims["kv_heads"], dims["head_dim"],
+            max_seq=self.max_seq, num_blocks=cache_blocks,
+            block_size=cache_block_size, name=self._name)
+        self._mb = self.cache.max_blocks_per_seq
+        self._lock = threading.Lock()
+        self._queue = collections.deque()
+        self._closing = False
+        self._closed = False
+        self._killed = False
+        self._paused = False
+        self._work = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        # engine-local SLO state (real numbers with telemetry off)
+        self._itl = collections.deque(maxlen=8192)
+        self._tokens = 0
+        self._chunks = 0
+        self._prefills = 0
+        self._requests_ok = 0
+        self._refused = 0
+        self._shed = 0
+        self._timeouts = 0
+        self._failed = 0
+        self._compiles = 0
+        self._decode_wall = 0.0
+        self._sealed = False
+        # slot state (scheduler-thread-private after start)
+        n = self._slots
+        self._slot_req = [None] * n
+        self._slot_tables = [None] * n
+        self._lens = _np.zeros(n, _np.int32)
+        self._token = _np.zeros(n, _np.int32)
+        self._active = _np.zeros(n, bool)
+        self._remaining = _np.zeros(n, _np.int32)
+        self._temp = _np.ones(n, _np.float32)
+        self._topk = _np.zeros(n, _np.int32)
+        self._topp = _np.ones(n, _np.float32)
+        self._greedy = _np.ones(n, bool)
+        self._eos = _np.full(n, -1, _np.int32)
+        self._deploy(seed)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mxtpu-genserve-{self._name}")
+        if autostart:
+            self._thread.start()
+
+    @staticmethod
+    def _normalize_buckets(shapes):
+        if base.is_int(shapes):
+            shapes = [shapes]
+        out = set()
+        for s in shapes:
+            if isinstance(s, (tuple, list)):
+                if len(s) != 1:
+                    raise MXNetError(
+                        "generation buckets are PROMPT LENGTHS (ints or "
+                        f"1-tuples); got {s!r}")
+                s = s[0]
+            out.add(int(s))
+        buckets = sorted(out)
+        if not buckets or buckets[0] <= 0:
+            raise MXNetError(f"invalid prompt buckets {shapes!r}")
+        return buckets
+
+    # -- deploy: build + AOT-compile + warm + seal -------------------------
+    def _deploy(self, seed):
+        import jax
+        import jax.numpy as jnp
+
+        step = self._net.decode_step_fn()
+        prefill = self._net.prefill_fn()
+        params = self._net.params()
+        chunk_t = self._chunk
+
+        def chunk_fn(params, k_pool, v_pool, tables, lens, token, active,
+                     remaining, rng, temp, top_k, top_p, greedy, eos):
+            def body(carry, _):
+                k_pool, v_pool, lens, token, active, remaining, rng = carry
+                logits, k_pool, v_pool = step(params, token, lens, k_pool,
+                                              v_pool, tables, active)
+                rng, sub = jax.random.split(rng)
+                nxt = sample_tokens(logits, sub, temp, top_k, top_p,
+                                    greedy)
+                emitted = active
+                nxt = jnp.where(emitted, nxt, 0)
+                lens = lens + active.astype(lens.dtype)
+                remaining = remaining - active.astype(remaining.dtype)
+                hit_eos = (nxt == eos) & (eos >= 0)
+                active = active & ~hit_eos & (remaining > 0)
+                return ((k_pool, v_pool, lens, nxt, active, remaining,
+                         rng), (nxt, emitted))
+
+            carry = (k_pool, v_pool, lens, token, active, remaining, rng)
+            carry, (toks, flags) = jax.lax.scan(body, carry, None,
+                                                length=chunk_t)
+            k_pool, v_pool, lens, token, active, remaining, rng = carry
+            return (k_pool, v_pool, lens, token, active, remaining, rng,
+                    toks, flags)
+
+        def prefill_fn(params, tokens, k_pool, v_pool, table, length,
+                       seed_v, temp, top_k, top_p, greedy):
+            logits, k_pool, v_pool = prefill(params, tokens, k_pool,
+                                             v_pool, table, length)
+            key = jax.random.fold_in(jax.random.PRNGKey(0), seed_v[0])
+            tok = sample_tokens(logits, key, temp, top_k, top_p, greedy)
+            return tok, k_pool, v_pool
+
+        n, mb = self._slots, self._mb
+        self._params = params
+        self._rng = jax.random.PRNGKey(int(seed))
+        k_shape = self.cache.k_pool
+        chunk_args = (params, k_shape, self.cache.v_pool,
+                      jnp.zeros((n, mb), jnp.int32),
+                      jnp.zeros(n, jnp.int32), jnp.zeros(n, jnp.int32),
+                      jnp.zeros(n, bool), jnp.zeros(n, jnp.int32),
+                      self._rng, jnp.ones(n, jnp.float32),
+                      jnp.zeros(n, jnp.int32), jnp.ones(n, jnp.float32),
+                      jnp.ones(n, bool), jnp.full(n, -1, jnp.int32))
+        jfn = jax.jit(chunk_fn, donate_argnums=(1, 2))
+        t0 = time.perf_counter()
+        self._chunk_exe = jfn.lower(*chunk_args).compile()
+        self._record_compile("decode_chunk", t0)
+        if _obs.introspect.ENABLED \
+                and not _obs.introspect.registered("decode_chunk"):
+            _obs.introspect.register_jit(
+                "decode_chunk", jfn,
+                _obs.introspect.avals_of(chunk_args), donated=True)
+        # warm run: all slots inactive -> writes land in the null block,
+        # lens unchanged, rng advances; adopts the returned pools
+        out = self._chunk_exe(*chunk_args)
+        jax.block_until_ready(out[0])
+        self.cache.update_pools(out[0], out[1])
+        self._rng = out[6]
+
+        self._prefill_exes = {}
+        jpf = jax.jit(prefill_fn, donate_argnums=(2, 3))
+        for tb in self._buckets:
+            if tb > self.max_seq:
+                raise MXNetError(
+                    f"prompt bucket {tb} exceeds the net's max_seq "
+                    f"{self.max_seq}")
+            args = (params, jnp.zeros((1, tb), jnp.int32),
+                    self.cache.k_pool, self.cache.v_pool,
+                    jnp.zeros((1, mb), jnp.int32),
+                    jnp.zeros(1, jnp.int32), jnp.zeros(1, jnp.int32),
+                    jnp.ones(1, jnp.float32), jnp.zeros(1, jnp.int32),
+                    jnp.ones(1, jnp.float32), jnp.ones(1, bool))
+            t0 = time.perf_counter()
+            exe = jpf.lower(*args).compile()
+            self._prefill_exes[tb] = exe
+            self._record_compile(f"decode_prefill[{tb}]", t0)
+            site = f"decode_prefill[{self._name}:{tb}]"
+            if _obs.introspect.ENABLED \
+                    and not _obs.introspect.registered(site):
+                _obs.introspect.register_jit(
+                    site, jpf, _obs.introspect.avals_of(args),
+                    donated=True)
+            # warm run: length 0 -> every write goes to the null block
+            tok, kp, vp = exe(*args)
+            jax.block_until_ready(tok)
+            self.cache.update_pools(kp, vp)
+        self._sealed = True
+
+    def _record_compile(self, what, t0):
+        self._compiles += 1
+        if _obs.ENABLED:
+            _obs.SERVE_COMPILE_TOTAL.inc(1, model=self._name)
+            _obs.tracer().record(
+                "serving.compile", cat="serving", ts=t0,
+                dur=time.perf_counter() - t0,
+                args={"model": self._name, "version": self._version,
+                      "bucket": str(what)})
+
+    # -- submit path -------------------------------------------------------
+    def _bucket_for(self, plen):
+        for tb in self._buckets:
+            if plen <= tb:
+                return tb
+        return None
+
+    def submit(self, x, max_new_tokens=None, temperature=1.0, top_k=0,
+               top_p=1.0, greedy=True, seed=None, eos=None,
+               deadline_ms=None, **_ignored) -> GenerateFuture:
+        """Queue one prompt (1-D int token array; a leading singleton
+        batch axis is squeezed). Typed refusals mirror the one-shot
+        engine: :class:`EngineClosed`, :class:`ServerOverloaded` (queue
+        full), :class:`RetraceForbidden` (no prompt bucket fits —
+        sealed, never compiles). ``max_new_tokens`` is clipped so
+        ``prompt + generated <= max_seq``."""
+        prompt = _np.asarray(x)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ServingError(
+                "generation takes ONE 1-D prompt of token ids per "
+                f"submit; got shape {prompt.shape}")
+        prompt = prompt.astype(_np.int32)
+        plen = int(prompt.size)
+        bucket = self._bucket_for(plen)
+        if bucket is None or plen >= self.max_seq:
+            self._refused += 1
+            if _obs.ENABLED:
+                _obs.record_serve_request(self._name, "error")
+            raise RetraceForbidden(
+                f"sealed generation engine {self._name}:{self._version} "
+                f"has no prefill bucket for prompt length {plen} "
+                f"(cause: shape; retrace budget is 0 after warmup). "
+                f"Known buckets: {self._buckets}, max_seq {self.max_seq}. "
+                "Truncate the prompt, or add a bucket and redeploy.")
+        max_new = int(max_new_tokens) if max_new_tokens else \
+            self._max_new_default
+        max_new = max(1, min(max_new, self.max_seq - plen))
+        deadline = (time.perf_counter() + float(deadline_ms) / 1e3
+                    if deadline_ms else None)
+        req = _GenRequest(
+            prompt, max_new, temperature, top_k, top_p, greedy,
+            seed if seed is not None else _np.random.randint(1 << 30),
+            eos if eos is not None else -1, deadline)
+        with self._lock:
+            if self._closing or self._killed or self._paused:
+                if _obs.ENABLED:
+                    _obs.record_serve_request(self._name, "closed")
+                raise EngineClosed(
+                    f"generation engine {self._name}:{self._version} is "
+                    "not accepting requests "
+                    f"({'paused' if self._paused else 'closed'})")
+            if len(self._queue) >= self._queue_cap:
+                self._shed += 1
+                if _obs.ENABLED:
+                    _obs.record_serve_request(self._name, "shed")
+                raise ServerOverloaded(
+                    f"generation queue full ({self._queue_cap}) on "
+                    f"{self._name}:{self._version} — retry with backoff")
+            self._queue.append(req)
+            self._idle.clear()
+        self._work.set()
+        if _obs.ENABLED:
+            _obs.SERVE_QUEUE_DEPTH.set(self.queue_depth(),
+                                       model=self._name)
+        return GenerateFuture(req)
+
+    def predict(self, x, timeout=None, **kwargs):
+        """Synchronous generation: submit + wait; returns np.int32
+        generated token ids."""
+        return self.submit(x, **kwargs).result(timeout)
+
+    # -- scheduler loop ----------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._lock:
+                killed = self._killed
+            if killed:
+                self._abort_all(ReplicaDead(
+                    f"generation engine {self._name}:{self._version} was "
+                    "killed (host-death simulation) — request failed over "
+                    "by the fleet router"))
+                return
+            self._admit()
+            if self._active.any():
+                self._step_chunk()
+                continue
+            with self._lock:
+                drained = not self._queue
+                closing = self._closing
+            if drained:
+                self._idle.set()
+                if closing:
+                    return
+            self._work.wait(0.02)
+            self._work.clear()
+
+    def _fail(self, req, err, code):
+        self._failed += 1
+        if _obs.ENABLED:
+            _obs.record_serve_request(self._name, code)
+        req.finish(error=err, version=self._version)
+
+    def _admit(self):
+        """Join queued requests to idle slots (iteration-level
+        scheduling): sweep deadlines, then prefill into free slots
+        while the cache can back the prompt."""
+        now = time.perf_counter()
+        with self._lock:
+            q = list(self._queue)
+        for req in q:
+            if req.deadline is not None and now > req.deadline \
+                    and not req.claimed:
+                with self._lock:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                self._timeouts += 1
+                self._fail(req, RequestTimeout(
+                    "generation deadline expired before a slot opened"),
+                    "timeout")
+        while True:
+            free = [s for s in range(self._slots) if not self._active[s]
+                    and self._slot_req[s] is None]
+            if not free:
+                return
+            with self._lock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                return
+            if not req.claim():  # lost to cancel()
+                continue
+            try:
+                table = self.cache.allocate(len(req.prompt))
+            except KVCacheOOM as e:
+                if self._active.any():
+                    # blocks free as running sequences retire: put the
+                    # request back and retry after the next chunk
+                    with req._state_lock:
+                        req.claimed = False
+                    with self._lock:
+                        self._queue.appendleft(req)
+                    return
+                self._fail(req, e, "shed")
+                continue
+            try:
+                self._prefill(req, table, free[0])
+            except BaseException as e:  # noqa: BLE001 - typed to waiter
+                self.cache.release(table)
+                self._fail(req, e if isinstance(e, ServingError) else
+                           ServingError(f"prefill failed: {e}"), "error")
+
+    def _prefill(self, req, table, slot):
+        import jax.numpy as jnp
+
+        plen = len(req.prompt)
+        tb = self._bucket_for(plen)
+        padded = _np.zeros((1, tb), _np.int32)
+        padded[0, :plen] = req.prompt
+        k, v = self.cache.pools()
+        t0 = time.perf_counter()
+        tok, k, v = self._prefill_exes[tb](
+            self._params, jnp.asarray(padded), k, v,
+            table.device_row(self._mb)[None, :],
+            _np.array([plen], _np.int32),  # mxtpu-lint: host-sync-ok
+            _np.array([req.seed], _np.int32),  # mxtpu-lint: host-sync-ok
+            _np.array([max(req.temperature, 1e-6)], _np.float32),  # mxtpu-lint: host-sync-ok
+            _np.array([req.top_k], _np.int32),  # mxtpu-lint: host-sync-ok
+            _np.array([req.top_p], _np.float32),  # mxtpu-lint: host-sync-ok
+            _np.array([req.greedy], bool))  # host operand staging  # mxtpu-lint: host-sync-ok
+        self.cache.update_pools(k, v)
+        # the ONE deliberate per-request sync: the first token decides
+        # retire-or-seat before the next chunk can include this slot
+        first = int(_np.asarray(tok)[0])  # mxtpu-lint: host-sync-ok
+        dt = time.perf_counter() - t0
+        table.length = plen
+        self._prefills += 1
+        now = time.perf_counter()
+        req.tokens.append(first)
+        req.t_first = req.t_last = now
+        self._tokens += 1
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("decode_prefill")
+            _obs.DECODE_PREFILL_SECONDS.observe(dt, model=self._name)
+            _obs.DECODE_TOKENS_TOTAL.inc(1, model=self._name)
+        done = (req.max_new <= 1
+                or (req.eos >= 0 and first == req.eos))
+        if done:
+            self._retire(req, table)
+            return
+        self._slot_req[slot] = req
+        self._slot_tables[slot] = table
+        self._lens[slot] = plen  # next decode step writes position plen
+        self._token[slot] = first
+        self._active[slot] = True
+        self._remaining[slot] = req.max_new - 1
+        self._temp[slot] = max(req.temperature, 1e-6)
+        self._topk[slot] = req.top_k
+        self._topp[slot] = req.top_p
+        self._greedy[slot] = req.greedy
+        self._eos[slot] = req.eos
+        if _obs.ENABLED:
+            _obs.DECODE_ACTIVE_SLOTS.set(
+                int(self._active.sum()),  # host numpy mirror  # mxtpu-lint: host-sync-ok
+                model=self._name)
+
+    def _step_chunk(self):
+        """One decode dispatch: every active slot advances up to
+        ``chunk`` tokens; retirements free their slots and cache blocks
+        at the boundary (where the NEXT _admit can seat a newcomer)."""
+        import jax.numpy as jnp
+
+        # back the chunk's cache growth per slot; a pool too full to
+        # grow a sequence retires that request early (typed OOM)
+        for s in range(self._slots):
+            if not self._active[s]:
+                continue
+            need = int(self._lens[s]) + min(  # mxtpu-lint: host-sync-ok
+                self._chunk,
+                int(self._remaining[s]))  # host numpy mirror  # mxtpu-lint: host-sync-ok
+            try:
+                self.cache.ensure(self._slot_tables[s],
+                                  min(need, self.max_seq))
+            except KVCacheOOM as e:
+                req = self._slot_req[s]
+                self.cache.release(self._slot_tables[s])
+                self._clear_slot(s)
+                self._fail(req, e, "shed")
+        if not self._active.any():
+            return
+        tables = _np.zeros((self._slots, self._mb), _np.int32)
+        for s in range(self._slots):
+            if self._slot_tables[s] is not None:
+                tables[s] = self._slot_tables[s].device_row(self._mb)
+        k, v = self.cache.pools()
+        t0 = time.perf_counter()
+        (k, v, lens, token, active, remaining, rng, toks, flags) = \
+            self._chunk_exe(
+                self._params, k, v, jnp.asarray(tables),
+                jnp.asarray(self._lens), jnp.asarray(self._token),
+                jnp.asarray(self._active), jnp.asarray(self._remaining),
+                self._rng, jnp.asarray(self._temp),
+                jnp.asarray(self._topk), jnp.asarray(self._topp),
+                jnp.asarray(self._greedy), jnp.asarray(self._eos))
+        self.cache.update_pools(k, v)
+        self._rng = rng
+        # ONE host sync per chunk: everything the scheduler needs
+        # (np.array copies — jax device views are read-only and the
+        # slot mirrors are mutated at admission)
+        toks = _np.asarray(toks)  # (chunk, slots)  # mxtpu-lint: host-sync-ok
+        flags = _np.asarray(flags)  # mxtpu-lint: host-sync-ok
+        self._lens = _np.array(lens)  # mxtpu-lint: host-sync-ok
+        self._token = _np.array(token)  # mxtpu-lint: host-sync-ok
+        self._active = _np.array(active)  # mxtpu-lint: host-sync-ok
+        self._remaining = _np.array(remaining)  # mxtpu-lint: host-sync-ok
+        dt = time.perf_counter() - t0
+        self._decode_wall += dt
+        self._chunks += 1
+        now = time.perf_counter()
+        emitted_total = 0
+        for s in range(self._slots):
+            req = self._slot_req[s]
+            if req is None:
+                continue
+            mask = flags[:, s]
+            n = int(mask.sum())  # host numpy  # mxtpu-lint: host-sync-ok
+            if n:
+                req.tokens.extend(
+                    int(t) for t in toks[mask, s])  # mxtpu-lint: host-sync-ok
+                # tokens of one chunk arrive together: the honest
+                # inter-token latency is the amortized chunk wall time
+                per_tok = dt / n
+                if req.t_first is None:
+                    req.t_first = now
+                req.t_last = now
+                for _ in range(n):
+                    self._itl.append(per_tok)
+                if _obs.ENABLED:
+                    _obs.DECODE_ITL_SECONDS.observe(per_tok,
+                                                    model=self._name)
+                emitted_total += n
+            if not self._active[s]:
+                table = self._slot_tables[s]
+                self._clear_slot(s)
+                self._retire(req, table)
+        self._tokens += emitted_total
+        if _obs.ENABLED:
+            _obs.record_xla_dispatch("decode_chunk")
+            _obs.DECODE_CHUNKS_TOTAL.inc(1, model=self._name)
+            if emitted_total:
+                _obs.DECODE_TOKENS_TOTAL.inc(emitted_total,
+                                             model=self._name)
+            _obs.DECODE_ACTIVE_SLOTS.set(
+                int(self._active.sum()),  # host numpy mirror  # mxtpu-lint: host-sync-ok
+                model=self._name)
+
+    def _clear_slot(self, s):
+        self._slot_req[s] = None
+        self._slot_tables[s] = None
+        self._active[s] = False
+        self._lens[s] = 0
+        self._token[s] = 0
+        self._remaining[s] = 0
+
+    def _retire(self, req, table):
+        self.cache.release(table)
+        self._requests_ok += 1
+        if _obs.ENABLED:
+            _obs.record_serve_request(self._name, "ok")
+            _obs.SERVE_LATENCY_SECONDS.observe(
+                time.perf_counter() - req.t_submit, model=self._name)
+        req.finish(result=_np.asarray(req.tokens, _np.int32),
+                   version=self._version)
+
+    def _abort_all(self, err):
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+        for req in queued:
+            self._fail(req, err, "closed")
+        for s in range(self._slots):
+            req = self._slot_req[s]
+            if req is not None:
+                if self._slot_tables[s] is not None:
+                    self.cache.release(self._slot_tables[s])
+                self._clear_slot(s)
+                self._fail(req, err, "closed")
+        self._idle.set()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def version(self):
+        return self._version
+
+    @property
+    def buckets(self):
+        """Prompt-length buckets, 1-tuples (InferenceEngine shape)."""
+        return [(b,) for b in self._buckets]
+
+    @property
+    def sealed(self):
+        return self._sealed
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def active_slots(self) -> int:
+        return int(self._active.sum())
+
+    def stats(self) -> dict:
+        """Engine-local snapshot (plain floats; telemetry-independent).
+        ``retraces_after_warmup`` is structurally 0: every executable is
+        AOT-sealed and slot liveness is an operand, never a shape."""
+        itl = _np.asarray(self._itl, _np.float64) if self._itl else None
+        dispatches = self._chunks + self._prefills
+        return {
+            "model": self._name,
+            "version": self._version,
+            "engine": "generation",
+            "buckets": list(self._buckets),
+            "slots": self._slots,
+            "chunk": self._chunk,
+            "requests_ok": self._requests_ok,
+            "refused": self._refused,
+            "shed": self._shed,
+            "timeouts": self._timeouts,
+            "failed": self._failed,
+            "tokens_generated": self._tokens,
+            "prefills": self._prefills,
+            "decode_chunks": self._chunks,
+            "dispatches": dispatches,
+            "tokens_per_dispatch": self._tokens / max(1, dispatches),
+            "tokens_per_s": (self._tokens / self._decode_wall
+                             if self._decode_wall else 0.0),
+            "itl_p50_ms": (float(_np.percentile(itl, 50)) * 1e3
+                           if itl is not None else None),
+            "itl_p99_ms": (float(_np.percentile(itl, 99)) * 1e3
+                           if itl is not None else None),
+            "queue_depth": self.queue_depth(),
+            "active_slots": self.active_slots(),
+            "compiles": self._compiles,
+            "retraces_after_warmup": 0 if self._sealed else None,
+            "recompiles_after_warmup": 0 if self._sealed else None,
+            "cache": self.cache.stats(),
+        }
+
+    def canary(self):
+        """Deploy-time verification: a short greedy generation must
+        return in-vocabulary token ids (the repository's staged-load
+        veto for generation engines — finite-logits NaN screens ride
+        the argmax: NaN logits produce out-of-range/degenerate ids)."""
+        started = self._thread.is_alive()
+        if not started:
+            self._thread.start()
+        toks = self.predict(_np.array([1, 2], _np.int32),
+                            max_new_tokens=2, greedy=True, timeout=60.0)
+        if len(toks) == 0 or _np.any(toks < 0) \
+                or _np.any(toks >= self.vocab_size):
+            raise ServingError(
+                f"generation canary produced out-of-vocabulary ids "
+                f"{toks!r} — refusing to serve this version")
+        return toks
+
+    # -- lifecycle ---------------------------------------------------------
+    def pause(self):
+        """Stop accepting work and drain: queued + in-flight
+        generations complete, executables and pools stay resident
+        (repository standby — resume() is a flag flip)."""
+        with self._lock:
+            if self._paused or self._closing:
+                return
+            self._paused = True
+        self._work.set()
+        self._idle.wait(timeout=120.0)
+
+    def resume(self):
+        with self._lock:
+            if self._closing or self._killed:
+                raise EngineClosed(
+                    f"engine {self._name}:{self._version} was released; "
+                    "reload instead of resume")
+            self._paused = False
+
+    def kill(self):
+        """Abrupt host-death simulation: queued AND in-flight requests
+        fail with typed :class:`ReplicaDead` (the fleet router fails
+        them over); nothing drains. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._killed = True
+            self._closing = True
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30.0)
+        else:
+            self._abort_all(ReplicaDead(
+                f"generation engine {self._name}:{self._version} killed"))
+        self._release()
+
+    def close(self):
+        """Drain queued + in-flight generations, then release
+        executables, pools, and weight references. Idempotent."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        self._work.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=120.0)
+        self._abort_all(EngineClosed(
+            f"generation engine {self._name}:{self._version} closed"))
+        self._release()
+
+    def _release(self):
+        self._closed = True
+        self._chunk_exe = None
+        self._prefill_exes = {}
+        self._params = None
+        self.cache.k_pool = None
+        self.cache.v_pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -48,6 +48,7 @@ from ..resilience import chaos as _chaos
 from .errors import (
     BrownoutShed,
     EngineClosed,
+    KVCacheOOM,
     ReplicaDead,
     ReplicaLost,
     RequestCancelled,
@@ -71,7 +72,7 @@ _UIDS = itertools.count(1)
 _ERROR_TYPES = {cls.__name__: cls for cls in (
     ServingError, ServerOverloaded, BrownoutShed, RequestTimeout,
     RequestTooLarge, EngineClosed, RetraceForbidden, StagedLoadError,
-    RequestCancelled, ReplicaDead, ReplicaLost, MXNetError)}
+    RequestCancelled, ReplicaDead, ReplicaLost, KVCacheOOM, MXNetError)}
 _ERROR_TYPES["TimeoutError"] = TimeoutError
 
 
@@ -107,7 +108,8 @@ def build_net(net_spec):
     ``"module:attr"`` path is imported (the ONLY callable form that
     crosses the process boundary), and ``{"dense": {...}}`` builds the
     builtin deterministic net."""
-    if hasattr(net_spec, "aot_predict_fn"):
+    if hasattr(net_spec, "aot_predict_fn") \
+            or hasattr(net_spec, "decode_step_fn"):
         return net_spec
     if isinstance(net_spec, str):
         mod, _, attr = net_spec.partition(":")
@@ -117,12 +119,18 @@ def build_net(net_spec):
         return build_net(getattr(importlib.import_module(mod), attr))
     if isinstance(net_spec, dict) and "dense" in net_spec:
         return _dense_net(**dict(net_spec["dense"]))
+    if isinstance(net_spec, dict) and "decoder" in net_spec:
+        # generation workload: every replica rebuilds the decoder from
+        # the same seeded spec, so the fleet serves identical weights
+        from .decoder import TransformerDecoderLM
+
+        return TransformerDecoderLM(**dict(net_spec["decoder"]))
     if callable(net_spec):
         return build_net(net_spec())
     raise MXNetError(
         f"cannot build a replica net from {type(net_spec).__name__} "
-        "(want a block, a factory, 'module:callable', or "
-        "{'dense': {...}})")
+        "(want a block, a factory, 'module:callable', "
+        "{'dense': {...}}, or {'decoder': {...}})")
 
 
 def normalize_spec(spec) -> dict:

@@ -36,7 +36,12 @@ from .errors import EngineClosed, ServingError, StagedLoadError
 
 def _default_verify(engine):
     """Canary: one zero-filled row through every bucket, results must
-    be finite. Catches NaN/garbage weights before the flip."""
+    be finite. Catches NaN/garbage weights before the flip. Engines
+    exposing their own ``canary()`` (GenerationEngine: a short greedy
+    generation must stay in-vocabulary) delegate to it."""
+    if hasattr(engine, "canary"):
+        engine.canary()
+        return
     for bucket in engine.buckets:
         out = engine.predict(_np.zeros(tuple(bucket), engine._dtype),
                              timeout=30.0)
@@ -70,11 +75,14 @@ class ModelRepository:
              verify=None, **engine_kwargs):
         """Stage -> verify -> flip. Returns the new live engine.
 
-        ``net_or_factory``: a block (HybridBlock / QuantizedNet) or a
-        zero-arg callable building one (the factory runs inside the
-        stage, so a crash there also never touches the live version).
+        ``net_or_factory``: a block (HybridBlock / QuantizedNet), a
+        decode-capable net (``decode_step_fn`` — served by a
+        :class:`~.generation.GenerationEngine` instead), or a zero-arg
+        callable building one (the factory runs inside the stage, so a
+        crash there also never touches the live version).
         ``verify``: optional callable(engine) raising to veto; the
-        default canary checks finite outputs on every bucket."""
+        default canary checks finite outputs on every bucket (greedy
+        in-vocabulary generation for generation engines)."""
         with self._lock:
             prev = (self._models.get(name) or {}).get("live")
         if version is None:
@@ -83,9 +91,14 @@ class ModelRepository:
         try:
             net = net_or_factory() if callable(net_or_factory) \
                 and not hasattr(net_or_factory, "aot_predict_fn") \
+                and not hasattr(net_or_factory, "decode_step_fn") \
                 else net_or_factory
-            engine = InferenceEngine(net, shapes, name=name,
-                                     version=version, **engine_kwargs)
+            if hasattr(net, "decode_step_fn"):
+                from .generation import GenerationEngine as _cls
+            else:
+                _cls = InferenceEngine
+            engine = _cls(net, shapes, name=name,
+                          version=version, **engine_kwargs)
             (verify or _default_verify)(engine)
         except BaseException as e:
             if engine is not None:

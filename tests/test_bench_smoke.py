@@ -37,6 +37,7 @@ def _smoke_env(tmp_path):
     env["BENCH_PR13_OUT"] = str(tmp_path / "BENCH_pr13.json")
     env["BENCH_PR15_OUT"] = str(tmp_path / "BENCH_pr15.json")
     env["BENCH_PR17_OUT"] = str(tmp_path / "BENCH_pr17.json")
+    env["BENCH_PR18_OUT"] = str(tmp_path / "BENCH_pr18.json")
     env["BENCH_STATUS_OUT"] = str(tmp_path / "BENCH_STATUS.json")
     env["BENCH_TELEMETRY_OUT"] = str(tmp_path / "BENCH_telemetry.jsonl")
     return env
@@ -84,6 +85,11 @@ def _fleet_rec(recs):
     return fl[0] if fl else None
 
 
+def _decode_rec(recs):
+    dc = [r for r in recs if r["metric"].startswith("decode_tokens_per_s")]
+    return dc[0] if dc else None
+
+
 #: the shared BENCH_ONLY re-run contract: a timing/pressure-sensitive
 #: assert that fails during the FULL run gets exactly one clean-
 #: subprocess retry of JUST its scenario (host pressure across a 10-
@@ -100,6 +106,7 @@ _STANDALONE = {
     "serving": (_serving_rec, ("BENCH_PR13_OUT",)),
     "federation": (_federation_rec, ("BENCH_PR15_OUT",)),
     "fleet": (_fleet_rec, ("BENCH_PR17_OUT",)),
+    "decode": (_decode_rec, ("BENCH_PR18_OUT",)),
 }
 
 
@@ -340,6 +347,77 @@ def test_bench_emits_driver_contract(tmp_path):
     verdict = json.loads(diff.stdout)
     assert not verdict["pass"] and any(
         f["key"] == "p99_in_slo" for f in verdict["failures"]), verdict
+    # decode fast-path scenario (PR18): the correctness gates are HARD
+    # — greedy decode through the paged cache matched the dense
+    # full-context oracle, a request late-joined the running batch, the
+    # sealed engine never recompiled, the cache drained to empty, and
+    # dispatches/token held the 1/chunk amortized contract (bench.py
+    # raises on any of these, so the record existing means they held;
+    # re-assert the flags it stamped anyway). tokens/s + ITL are the
+    # pressure-sensitive pair — they gate against the committed
+    # BENCH_pr18.json through bench_diff with the standalone retry.
+    dc = _decode_rec(recs)
+    assert dc, names
+    assert dc["recompiles_after_warmup"] == 0, dc
+    assert dc["cache_match_ok"] == 1, dc
+    assert dc["late_join_ok"] == 1, dc
+    assert any(n.startswith("decode_itl_p50") for n in names)
+    assert any(n.startswith("decode_itl_p99") for n in names)
+    assert any(n.startswith("decode_cache_peak_occupancy")
+               for n in names)
+    pr18_path = env["BENCH_PR18_OUT"]
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   pr18_path, os.path.join(ROOT, "BENCH_pr18.json"),
+                   "--tolerance", "0.9", "--json"],
+                  capture_output=True, text=True, timeout=60)
+    if diff.returncode != 0:
+        dc, res2 = _rerun_standalone(env, "decode")
+        assert dc and dc["recompiles_after_warmup"] == 0 \
+            and dc["cache_match_ok"] == 1, \
+            (dc, res.stderr[-1000:], res2.stderr[-1000:])
+        pr18_path += ".retry"  # gate the clean re-run, not the noisy one
+        diff = sp.run([sys.executable,
+                       os.path.join(ROOT, "tools", "bench_diff.py"),
+                       pr18_path, os.path.join(ROOT, "BENCH_pr18.json"),
+                       "--tolerance", "0.9", "--json"],
+                      capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 0, (diff.stdout, diff.stderr)
+    verdict = json.loads(diff.stdout)
+    assert verdict["pass"] and verdict["checked"] > 0, verdict
+    pr18 = json.load(open(pr18_path))
+    assert pr18["scenario"] == "decode" \
+        and pr18["recompiles_after_warmup"] == 0 \
+        and pr18["cache_match_ok"] == 1 \
+        and pr18["late_join_ok"] == 1 \
+        and pr18["cache_freed_ok"] == 1, pr18
+    # the committed baseline gates the trajectory both ways: a
+    # doctored copy with tokens/s collapsed -60% FAILS at the default
+    # band (higher-is-better direction pin), as does doctored ITL +60%
+    # (lower-is-better)
+    doctored = dict(pr18)
+    doctored["tokens_per_s"] = pr18["tokens_per_s"] * 0.4
+    doc_path = tmp_path / "BENCH_pr18_doctored.json"
+    doc_path.write_text(json.dumps(doctored))
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   str(doc_path), pr18_path, "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 1, (diff.returncode, diff.stdout)
+    verdict = json.loads(diff.stdout)
+    assert not verdict["pass"] and any(
+        f["key"] == "tokens_per_s" for f in verdict["failures"]), verdict
+    doctored = dict(pr18)
+    doctored["itl_p99_ms"] = pr18["itl_p99_ms"] * 1.6
+    doc_path.write_text(json.dumps(doctored))
+    diff = sp.run([sys.executable,
+                   os.path.join(ROOT, "tools", "bench_diff.py"),
+                   str(doc_path), pr18_path, "--json"],
+                  capture_output=True, text=True, timeout=60)
+    assert diff.returncode == 1, (diff.returncode, diff.stdout)
+    verdict = json.loads(diff.stdout)
+    assert not verdict["pass"] and any(
+        f["key"] == "itl_p99_ms" for f in verdict["failures"]), verdict
     # mixed-precision scenario (PR5): both legs emitted, the bf16 leg
     # carries the speedup + fp16 recovery flag, and BENCH_pr5.json lands
     amp_recs = [r for r in recs
@@ -356,6 +434,7 @@ def test_bench_emits_driver_contract(tmp_path):
     assert "amp" in status["completed"] and "superstep" in \
         status["completed"] and "elastic" in status["completed"] \
         and "fleet" in status["completed"] \
+        and "decode" in status["completed"] \
         and not status["failed"], status
     # MFU accounting contract (PR7): EVERY row carries flops_per_step
     # and mfu; a null always pairs with a reason (this CPU smoke has no

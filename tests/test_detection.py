@@ -3,6 +3,7 @@ contrib MultiBox* ops; BASELINE.json config #2 names the detection path).
 """
 
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
@@ -22,6 +23,7 @@ def test_ssd_forward_shapes():
     assert np.isfinite(a).all()
 
 
+@pytest.mark.slow
 def test_ssd_convergence_and_detection():
     """Loss decreases on a fixed synthetic scene; NMS output is static."""
     net = ssd_tiny(classes=3)
@@ -132,6 +134,7 @@ def test_faster_rcnn_forward_shapes():
     assert set(np.unique(ridx)) <= {0.0, 1.0}
 
 
+@pytest.mark.slow
 def test_faster_rcnn_trains_and_localizes():
     """Two-stage pipeline end to end: loss decreases AND the planted box
     is recovered at IoU > 0.5 through Proposal -> ROIAlign -> heads ->
@@ -188,6 +191,7 @@ def test_yolo3_forward_and_decode_shapes():
     assert (d[..., 2] > 0).all() and (d[..., 3] > 0).all()    # sizes > 0
 
 
+@pytest.mark.slow
 def test_yolo3_trains_and_localizes():
     """One-stage path end to end (BASELINE config #2's third architecture):
     loss decreases AND the planted box is recovered at IoU > 0.5."""

@@ -109,6 +109,7 @@ def _suffix_params(net):
     return {k.split("_", 1)[1]: v for k, v in net.collect_params().items()}
 
 
+@pytest.mark.slow
 def test_resnet50_fused_parity_f32():
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
@@ -167,6 +168,7 @@ def test_resnet50_fused_parity_f32():
             assert rel < 0.1, (k, rel)
 
 
+@pytest.mark.slow
 def test_resnet50_fused_parity_x64_subprocess():
     """Run the float64 semantic-parity check in a subprocess (x64 flag
     must be set before backend init). Verifies loss diff < 1e-9 and all

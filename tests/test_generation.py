@@ -1,0 +1,377 @@
+"""Autoregressive decode fast path (``mxnet_tpu.serving.generation``):
+the paged-cache decode must match a dense full-context recompute
+token-for-token, spend at most ~1 dispatch per chunk of tokens, never
+retrace after warmup, and keep the continuous-batching contracts
+(late join without drain, typed refusals, lifecycle).
+
+One module-scoped engine carries most tests — the sealed executables
+compile once; every test asserts on stat DELTAS so ordering never
+matters."""
+
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu.serving import (
+    EngineClosed,
+    GenerationEngine,
+    LocalReplica,
+    ModelRepository,
+    ReplicaDead,
+    RequestCancelled,
+    RequestTimeout,
+    RetraceForbidden,
+    ServingError,
+    TransformerDecoderLM,
+    sample_tokens,
+)
+
+VOCAB, MAX_SEQ, BUCKETS, SLOTS, CHUNK = 48, 64, [4, 8, 16], 4, 4
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_state():
+    obs.set_enabled(False)
+    obs.reset()
+    yield
+    obs.set_enabled(False)
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return TransformerDecoderLM(vocab_size=VOCAB, num_layers=2,
+                                d_model=32, num_heads=4, kv_heads=2,
+                                max_seq=MAX_SEQ, seed=0)
+
+
+@pytest.fixture(scope="module")
+def eng(net):
+    e = GenerationEngine(net, BUCKETS, slots=SLOTS, chunk=CHUNK,
+                         queue_cap=64, cache_blocks=96,
+                         cache_block_size=4, name="gen-test")
+    yield e
+    e.close()
+
+
+def _assert_matches_dense(net, prompt, toks):
+    """Dense full-context recompute check: ONE causal forward over
+    prompt+generated must greedy-predict every generated token from its
+    own prefix (equivalent to re-running the dense net per step — the
+    first mismatch fails exactly where a stepwise oracle would)."""
+    fwd, params = net.forward_fn(), net.params()
+    seq = np.array([int(t) for t in prompt] + [int(t) for t in toks],
+                   np.int32)
+    logits = np.asarray(fwd(params, seq[None]))
+    want = logits[0, len(prompt) - 1:len(seq) - 1].argmax(-1)
+    assert [int(t) for t in toks] == [int(t) for t in want]
+
+
+def _drain(eng, timeout=10.0):
+    deadline = time.perf_counter() + timeout
+    while (eng.active_slots() or eng.queue_depth()) \
+            and time.perf_counter() < deadline:
+        time.sleep(0.002)
+
+
+# ---------------------------------------------------------------------------
+# correctness vs dense recompute
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("prompt,n", [
+    ([3, 1, 4], 10),            # bucket 4, crosses 2 chunk boundaries
+    ([7, 2, 9, 11, 5, 40], 9),  # bucket 8, partial final chunk
+    (list(range(2, 15)), 17),   # bucket 16, multi-block prompt
+])
+def test_greedy_decode_matches_dense_recompute(eng, net, prompt, n):
+    toks = eng.predict(np.array(prompt, np.int32),
+                       max_new_tokens=n, greedy=True, timeout=60.0)
+    assert toks.dtype == np.int32
+    assert len(toks) == n
+    _assert_matches_dense(net, prompt, toks)
+
+
+def test_batch_axis_squeeze_and_validation(eng):
+    a = eng.predict(np.array([[5, 6, 7]], np.int32),
+                    max_new_tokens=3, timeout=60.0)
+    b = eng.predict(np.array([5, 6, 7], np.int32),
+                    max_new_tokens=3, timeout=60.0)
+    assert list(a) == list(b)
+    with pytest.raises(ServingError):
+        eng.submit(np.zeros((2, 3), np.int32))  # real batches: one each
+    with pytest.raises(ServingError):
+        eng.submit(np.array([], np.int32))
+
+
+def test_eos_stops_early_and_is_included(eng, net):
+    prompt = [3, 1, 4]
+    ref = eng.predict(np.array(prompt, np.int32), max_new_tokens=12,
+                      greedy=True, timeout=60.0)
+    _assert_matches_dense(net, prompt, ref)  # trusted greedy reference
+    eos = int(ref[5])
+    want = [int(t) for t in ref[:list(ref).index(eos) + 1]]
+    toks = eng.predict(np.array(prompt, np.int32), max_new_tokens=12,
+                       eos=eos, timeout=60.0)
+    assert [int(t) for t in toks] == want
+    assert toks[-1] == eos
+
+
+def test_max_new_clipped_to_max_seq(eng):
+    prompt = np.arange(1, 14, dtype=np.int32)  # plen 13, bucket 16
+    toks = eng.predict(prompt, max_new_tokens=10_000, timeout=120.0)
+    assert len(toks) == MAX_SEQ - 13
+
+
+def test_sampling_first_token_is_seed_deterministic(eng):
+    """The documented reproducibility contract: the prefill token is
+    drawn from the request's own seed (bit-stable run to run); later
+    tokens ride the engine-level per-chunk key stream."""
+    kw = dict(max_new_tokens=8, greedy=False, temperature=0.8,
+              top_k=12, seed=7, timeout=60.0)
+    a = eng.predict(np.array([9, 8, 7], np.int32), **kw)
+    b = eng.predict(np.array([9, 8, 7], np.int32), **kw)
+    assert a[0] == b[0]
+    c = eng.predict(np.array([9, 8, 7], np.int32),
+                    **{**kw, "seed": 1234})
+    for toks in (a, b, c):
+        assert np.all(toks >= 0) and np.all(toks < VOCAB)
+
+
+# ---------------------------------------------------------------------------
+# on-device sampler unit tests
+# ---------------------------------------------------------------------------
+
+def test_sample_tokens_policies():
+    import jax
+
+    rs = np.random.RandomState(0)
+    logits = np.asarray(rs.randn(3, 16), np.float32)
+    key = jax.random.PRNGKey(0)
+    ones = np.ones(3, np.float32)
+    zeros_i = np.zeros(3, np.int32)
+    amax = logits.argmax(-1)
+
+    def draw(temperature=ones, top_k=zeros_i, top_p=ones,
+             greedy=np.zeros(3, bool), k=key):
+        return np.asarray(sample_tokens(
+            np.asarray(logits), k, np.asarray(temperature),
+            np.asarray(top_k), np.asarray(top_p), np.asarray(greedy)))
+
+    assert np.array_equal(draw(greedy=np.ones(3, bool)), amax)
+    # top_k=1 collapses to argmax no matter the temperature
+    assert np.array_equal(
+        draw(temperature=ones * 5.0, top_k=np.ones(3, np.int32)), amax)
+    # a tiny nucleus keeps only the argmax (it always survives)
+    assert np.array_equal(draw(top_p=ones * 1e-6), amax)
+    # per-row policies compose inside ONE call
+    mixed = draw(greedy=np.array([True, False, False]),
+                 top_k=np.array([0, 1, 0], np.int32))
+    assert mixed[0] == amax[0] and mixed[1] == amax[1]
+    # seeded: same key -> same draw; keys differ -> free to differ
+    t = ones * 3.0
+    assert np.array_equal(draw(temperature=t), draw(temperature=t))
+    assert np.all(draw() >= 0) and np.all(draw() < 16)
+
+
+# ---------------------------------------------------------------------------
+# sealed-engine + dispatch-budget contracts
+# ---------------------------------------------------------------------------
+
+def test_over_bucket_prompt_is_typed_refusal_not_retrace(eng):
+    st0 = eng.stats()
+    with pytest.raises(RetraceForbidden, match="no prefill bucket"):
+        eng.submit(np.arange(17, dtype=np.int32))  # > max bucket 16
+    with pytest.raises(RetraceForbidden):
+        eng.submit(np.zeros(MAX_SEQ, np.int32))    # prompt fills max_seq
+    st1 = eng.stats()
+    assert st1["refused"] - st0["refused"] == 2
+    assert st1["compiles"] == st0["compiles"]
+
+
+def test_single_dispatch_chunk_budget(eng):
+    """One request of N tokens costs 1 prefill + ~ceil((N-1)/chunk)
+    chunk dispatches — the whole point of the fast path. Checked on the
+    engine's own counters AND the XLA dispatch telemetry."""
+    obs.set_enabled(True)
+    d0c = obs.XLA_DISPATCH_TOTAL.value(site="decode_chunk")
+    d0p = obs.XLA_DISPATCH_TOTAL.value(site="decode_prefill")
+    st0 = eng.stats()
+    n = 9  # prefill token + 8 more = 2 full chunks of 4
+    toks = eng.predict(np.array([2, 4, 6], np.int32),
+                       max_new_tokens=n, greedy=True, timeout=60.0)
+    assert len(toks) == n
+    st1 = eng.stats()
+    assert st1["prefills"] - st0["prefills"] == 1
+    chunks = st1["decode_chunks"] - st0["decode_chunks"]
+    assert chunks == -(-(n - 1) // CHUNK)  # exactly ceil, no slack
+    assert obs.XLA_DISPATCH_TOTAL.value(site="decode_chunk") - d0c \
+        == chunks
+    assert obs.XLA_DISPATCH_TOTAL.value(site="decode_prefill") - d0p == 1
+    assert st1["compiles"] == st0["compiles"]
+
+
+def test_ragged_traffic_never_retraces_and_frees_cache(eng, net):
+    """A burst of mixed prompt lengths / budgets / sampling policies:
+    zero compiles after warmup, zero retraces, amortized dispatch cost
+    under 1/chunk + scheduling slack, and the cache drains to empty."""
+    st0 = eng.stats()
+    rs = np.random.RandomState(3)
+    futs, oracle_checks = [], []
+    for i in range(14):
+        plen = int(rs.choice([3, 4, 6, 8, 11, 16]))
+        prompt = rs.randint(0, VOCAB, plen).astype(np.int32)
+        n = int(rs.choice([2, 5, 8, 13]))
+        if i % 3 == 0:
+            futs.append(eng.submit(prompt, max_new_tokens=n, greedy=True))
+            oracle_checks.append((len(futs) - 1, list(prompt), n))
+        else:
+            futs.append(eng.submit(prompt, max_new_tokens=n, greedy=False,
+                                   temperature=0.9, top_k=10,
+                                   top_p=0.95, seed=i))
+    outs = [f.result(120.0) for f in futs]
+    st1 = eng.stats()
+    assert st1["requests_ok"] - st0["requests_ok"] == 14
+    assert st1["compiles"] == st0["compiles"]  # warm: nothing compiled
+    assert st1["recompiles_after_warmup"] == 0
+    assert st1["retraces_after_warmup"] == 0
+    for idx, prompt, n in oracle_checks:  # greedy ones stay exact
+        assert len(outs[idx]) == n
+        _assert_matches_dense(net, prompt, outs[idx])
+    tokens = st1["tokens_generated"] - st0["tokens_generated"]
+    disp = st1["dispatches"] - st0["dispatches"]
+    prefills = st1["prefills"] - st0["prefills"]
+    assert (disp - prefills) <= ((tokens - prefills) / CHUNK) * 1.5 + 3
+    _drain(eng)
+    assert eng.stats()["cache"]["blocks_used"] == 0
+
+
+def test_late_join_rides_next_chunk_without_drain(eng):
+    long_f = eng.submit(np.array([1, 2, 3], np.int32),
+                        max_new_tokens=40, greedy=True)
+    deadline = time.perf_counter() + 10.0
+    while eng.active_slots() == 0 and time.perf_counter() < deadline:
+        time.sleep(0.001)
+    assert eng.active_slots() > 0
+    short_f = eng.submit(np.array([9, 9], np.int32),
+                         max_new_tokens=3, greedy=True)
+    assert len(short_f.result(60.0)) == 3
+    assert len(long_f.result(60.0)) == 40
+    # the short request joined mid-flight and retired first — token-
+    # level batching, not request-level (no drain between admissions)
+    assert short_f.token_times()[1] < long_f.token_times()[1]
+
+
+def test_deadline_expires_in_queue(eng):
+    longs = [eng.submit(np.array([5, 3], np.int32), max_new_tokens=30,
+                        greedy=True) for _ in range(SLOTS + 1)]
+    f = eng.submit(np.array([1, 1], np.int32), max_new_tokens=30,
+                   deadline_ms=0.1)
+    with pytest.raises(RequestTimeout):
+        f.result(60.0)
+    for lf in longs:
+        assert len(lf.result(120.0)) == 30  # bystanders unharmed
+
+
+def test_cancel_only_before_admission(eng):
+    longs = [eng.submit(np.array([5, 3], np.int32), max_new_tokens=25,
+                        greedy=True) for _ in range(SLOTS + 2)]
+    victim = eng.submit(np.array([2, 2], np.int32), max_new_tokens=4)
+    assert victim.cancel() is True
+    assert victim.cancelled()
+    with pytest.raises(RequestCancelled):
+        victim.result(10.0)
+    done = longs[0]
+    done.result(120.0)
+    assert done.cancel() is False  # too late: already ran
+    for lf in longs[1:]:
+        lf.result(120.0)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + integration (dedicated engines: these ones die)
+# ---------------------------------------------------------------------------
+
+def _tiny_net(**kw):
+    kw.setdefault("vocab_size", 32)
+    kw.setdefault("num_layers", 1)
+    kw.setdefault("d_model", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("max_seq", 32)
+    kw.setdefault("seed", 0)
+    return TransformerDecoderLM(**kw)
+
+
+_TINY_ENG = dict(slots=2, chunk=2, cache_blocks=24, cache_block_size=4)
+
+
+def test_pause_resume_kill_lifecycle():
+    e = GenerationEngine(_tiny_net(), [4], name="gen-life", **_TINY_ENG)
+    try:
+        assert len(e.predict([1, 2], max_new_tokens=2, timeout=60.0)) == 2
+        e.pause()
+        with pytest.raises(EngineClosed):
+            e.submit(np.array([1, 2], np.int32))
+        e.resume()
+        assert len(e.predict([1, 2], max_new_tokens=2, timeout=60.0)) == 2
+        f = e.submit(np.array([3, 1], np.int32), max_new_tokens=20)
+        e.kill()  # host death: in-flight fails typed, nothing hangs
+        with pytest.raises(ReplicaDead):
+            f.result(30.0)
+        with pytest.raises(EngineClosed):
+            e.resume()
+    finally:
+        e.close()  # idempotent after kill
+
+
+def test_close_drains_inflight():
+    e = GenerationEngine(_tiny_net(), [4], name="gen-drain", **_TINY_ENG)
+    f = e.submit(np.array([1, 2, 3], np.int32), max_new_tokens=10)
+    e.close()
+    assert len(f.result(1.0)) == 10  # drained, not aborted
+    with pytest.raises(EngineClosed):
+        e.submit(np.array([1, 2], np.int32))
+
+
+def test_repository_dispatches_decode_capable_nets():
+    """``repo.load`` sees ``decode_step_fn`` and serves the net with a
+    GenerationEngine behind the same repository surface — the fleet
+    stack from PR 17 needs zero changes."""
+    repo = ModelRepository()
+    try:
+        engine = repo.load("lm", _tiny_net(), [4], version="v1",
+                           **_TINY_ENG)
+        assert isinstance(engine, GenerationEngine)
+        st = repo.stats("lm")
+        assert st["engine"] == "generation"
+        toks = repo.predict("lm", np.array([1, 2, 3], np.int32),
+                            max_new_tokens=4, timeout=60.0)
+        assert len(toks) == 4
+        assert repo.stats("lm")["requests_ok"] >= 1
+    finally:
+        repo.close()
+
+
+def test_local_replica_serves_decoder_spec():
+    """The plain-dict ``{"decoder": ...}`` spec crosses the replica
+    boundary: same seed -> identical weights -> greedy output matches a
+    directly-built engine."""
+    net = _tiny_net()
+    spec = {"net": net.spec(), "shapes": [4], "version": "v1",
+            "engine": dict(_TINY_ENG)}
+    rep = LocalReplica(0, spec, name="lm")
+    try:
+        assert rep.state == "live"
+        got = rep.submit(np.array([4, 2, 1], np.int32),
+                         max_new_tokens=5, greedy=True).result(60.0)
+        direct = GenerationEngine(net, [4], name="lm-ref", **_TINY_ENG)
+        try:
+            want = direct.predict(np.array([4, 2, 1], np.int32),
+                                  max_new_tokens=5, greedy=True,
+                                  timeout=60.0)
+        finally:
+            direct.close()
+        assert list(got) == list(want)
+    finally:
+        rep.close()

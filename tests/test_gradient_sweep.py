@@ -183,6 +183,10 @@ EXCLUDED = {
     "_contrib_fused_scaled_matmul_stats":
         "hand-derived custom_vjp checked against jax autodiff in "
         "test_fused_conv_bn.py (test_custom_vjp_matches_autodiff)",
+    "paged_decode_attention":
+        "inference-only decode kernel (serving fast path, never under "
+        "autograd); forward parity vs dense recompute in "
+        "test_generation.py greedy-oracle checks",
     "sldwin_atten_score": "covered with flash_attention (banded kernels)",
     "sldwin_atten_context": "covered with flash_attention (banded kernels)",
     "_ctc_loss": "CTC gradient checked in test_contrib.py against torch",

@@ -110,7 +110,8 @@ def test_collective_signature_format_and_order():
 def test_missing_canonical():
     assert missing_canonical([]) != []
     full = list(CANONICAL_SITES) + [
-        "cachedop_fwd[n:1]", "cachedop_bwd[n:1]", "serving[s:8]", "op[x]"]
+        "cachedop_fwd[n:1]", "cachedop_bwd[n:1]", "serving[s:8]", "op[x]",
+        "decode_prefill[m:8]"]
     assert missing_canonical(full) == []
     assert "spmd_step" in missing_canonical(
         [s for s in full if s != "spmd_step"])

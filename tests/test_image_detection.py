@@ -157,6 +157,7 @@ def test_sync_label_shape(tmp_path):
     assert a.provide_label[0][1] == b.provide_label[0][1] == (2, 7, 5)
 
 
+@pytest.mark.slow
 def test_ssd_trains_through_pipeline(tmp_path):
     """VERDICT r3 item 5 done-criterion: SSD trains from a synthetic
     detection .rec via ImageDetIter with augmentation on."""

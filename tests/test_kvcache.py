@@ -1,0 +1,258 @@
+"""Paged KV cache (``mxnet_tpu.serving.kvcache``): the block-table
+allocator (free list + refcounts, typed OOM, fork/copy-on-write) and
+the pure in-graph paging helpers the decode model compiles against
+(null-block routing for inactive slots / pad positions, scatter +
+gather round-trips through the table indirection)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mxnet_tpu import observability as obs
+from mxnet_tpu.serving import BlockTable, KVCacheOOM, PagedKVCache
+from mxnet_tpu.serving.kvcache import (
+    paged_gather,
+    paged_prefill_write,
+    paged_write,
+    slot_coords,
+)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_state():
+    obs.set_enabled(False)
+    obs.reset()
+    yield
+    obs.set_enabled(False)
+    obs.reset()
+
+
+def _cache(num_blocks=16, block_size=4, layers=2, kv_heads=2, head_dim=3,
+           max_seq=32):
+    return PagedKVCache(layers, kv_heads, head_dim, max_seq=max_seq,
+                        num_blocks=num_blocks, block_size=block_size)
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+def test_allocate_release_round_trip():
+    c = _cache()
+    assert c.blocks_used() == 0
+    t = c.allocate(10)  # 3 blocks of 4
+    assert len(t.blocks) == 3
+    assert c.blocks_used() == 3
+    assert 0 not in t.blocks  # the null block is never handed out
+    c.release(t)
+    assert c.blocks_used() == 0
+    assert t.blocks == [] and t.length == 0
+    c.release(t)  # idempotent
+    assert c.blocks_used() == 0
+
+
+def test_zero_token_allocation_is_empty():
+    c = _cache()
+    t = c.allocate(0)
+    assert t.blocks == []
+    c.release(t)
+
+
+def test_oom_is_typed_and_non_destructive():
+    c = _cache(num_blocks=4)  # 3 usable
+    t = c.allocate(12)
+    with pytest.raises(KVCacheOOM, match="exhausted"):
+        c.allocate(1)
+    # the failed take mutated nothing: the held table still frees fully
+    c.release(t)
+    assert c.blocks_free() == 3
+    t2 = c.allocate(12)
+    c.release(t2)
+
+
+def test_ensure_grows_in_place():
+    c = _cache()
+    t = c.allocate(4)  # exactly 1 block
+    t.length = 4
+    c.ensure(t, 5)
+    assert len(t.blocks) == 2
+    c.ensure(t, 5)  # already covered: no growth
+    assert len(t.blocks) == 2
+    c.release(t)
+    assert c.blocks_used() == 0
+
+
+def test_fork_is_free_until_divergence():
+    c = _cache()
+    t = c.allocate(6)  # 2 blocks, second one partial (len 6, bs 4)
+    t.length = 6
+    used = c.blocks_used()
+    f = c.fork(t)
+    assert c.blocks_used() == used  # refcount bump only
+    assert f.blocks == t.blocks and f is not t
+    assert c.forks == 1
+    # release one holder: blocks stay (the other still references them)
+    c.release(f)
+    assert c.blocks_used() == used
+    c.release(t)
+    assert c.blocks_used() == 0
+
+
+def test_fork_copy_on_write_copies_exactly_one_block():
+    c = _cache()
+    t = c.allocate(6)
+    t.length = 6
+    f = c.fork(t)
+    used = c.blocks_used()
+    shared_tail = t.blocks[-1]
+    # the WRITER appending into the shared partial block gets a private
+    # copy of that one block; the reader keeps the original
+    c.ensure(f, 7)
+    assert c.cow_copies == 1
+    assert c.blocks_used() == used + 1
+    assert f.blocks[-1] != shared_tail
+    assert t.blocks[-1] == shared_tail
+    assert f.blocks[:-1] == t.blocks[:-1]  # full blocks still shared
+    # appending at a block boundary is NOT a divergence (no shared
+    # partial block to split) — plain growth
+    c.release(f)
+    f2 = c.fork(t)
+    f2.length = t.length = 8
+    c.ensure(f2, 9)
+    assert c.cow_copies == 1  # unchanged
+    c.release(f2)
+    c.release(t)
+    assert c.blocks_used() == 0
+
+
+def test_fork_free_round_trip_interleaved():
+    """Fork chains release in arbitrary order without leaking or
+    double-freeing blocks."""
+    c = _cache(num_blocks=32)
+    t = c.allocate(10)
+    t.length = 10
+    forks = [c.fork(t) for _ in range(3)]
+    c.release(t)                      # parent first
+    assert c.blocks_used() == 3      # children keep the blocks alive
+    c.ensure(forks[0], 11)            # COW under surviving forks
+    for f in forks:
+        c.release(f)
+    assert c.blocks_used() == 0
+    assert c.blocks_free() == 31
+    # every block is reusable after the churn
+    t2 = c.allocate(31 * 4)
+    assert len(t2.blocks) == 31
+    c.release(t2)
+
+
+def test_occupancy_accounting_and_gauges():
+    c = _cache(num_blocks=11)  # 10 usable
+    obs.set_enabled(True)
+    t = c.allocate(20)  # 5 blocks
+    assert c.occupancy() == pytest.approx(0.5)
+    assert c.stats()["blocks_used"] == 5
+    assert obs.KVCACHE_BLOCKS_USED.value(model=c.name) == 5
+    assert obs.KVCACHE_OCCUPANCY.value(model=c.name) == pytest.approx(0.5)
+    assert c.can_allocate(20) and not c.can_allocate(21)
+    c.release(t)
+    assert obs.KVCACHE_BLOCKS_USED.value(model=c.name) == 0
+
+
+def test_oom_counter_increments():
+    c = _cache(num_blocks=3)
+    obs.set_enabled(True)
+    t = c.allocate(8)
+    with pytest.raises(KVCacheOOM):
+        c.allocate(4)
+    assert obs.KVCACHE_OOM_TOTAL.value(model=c.name) == 1
+    c.release(t)
+
+
+def test_block_table_device_row_pads_with_null():
+    t = BlockTable([5, 9, 2], 0)
+    row = t.device_row(6)
+    assert row.dtype == np.int32
+    assert row.tolist() == [5, 9, 2, 0, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# pure in-graph helpers (the decode model compiles these)
+# ---------------------------------------------------------------------------
+
+def test_slot_coords_routes_inactive_to_null_block():
+    tables = np.array([[3, 7], [4, 6]], np.int32)
+    pos = np.array([5, 1], np.int32)
+    blk, off = slot_coords(tables, pos, 4,
+                           active=np.array([True, False]))
+    blk, off = np.asarray(blk), np.asarray(off)
+    assert blk.tolist() == [7, 0]  # slot 1 inactive -> null sink
+    assert off.tolist() == [1, 1]
+    blk2, _ = slot_coords(tables, pos, 4)  # no mask: all live
+    assert np.asarray(blk2).tolist() == [7, 4]
+
+
+def test_paged_write_then_gather_round_trip():
+    bs, kvh, d = 4, 2, 3
+    pool = jnp.zeros((8, bs, kvh, d), jnp.float32)
+    tables = np.array([[2, 5], [3, 0]], np.int32)
+    vals = np.arange(2 * kvh * d, dtype=np.float32).reshape(2, kvh, d)
+    blk, off = slot_coords(tables, np.array([5, 2], np.int32), bs)
+    pool = np.asarray(paged_write(pool, blk, off, vals))
+    # slot 0 pos 5 -> table[0][1]=5, offset 1; slot 1 pos 2 -> blk 3
+    assert np.array_equal(pool[5, 1], vals[0])
+    assert np.array_equal(pool[3, 2], vals[1])
+    gathered = np.asarray(paged_gather(pool, tables))
+    assert gathered.shape == (2, 2 * bs, kvh, d)
+    assert np.array_equal(gathered[0, 5], vals[0])
+    assert np.array_equal(gathered[1, 2], vals[1])
+
+
+def test_paged_prefill_write_masks_pad_positions():
+    bs, kvh, d = 4, 1, 2
+    pool = jnp.zeros((6, bs, kvh, d), jnp.float32)
+    table_row = np.array([2, 4], np.int32)
+    vals = np.ones((8, kvh, d), np.float32)  # padded prompt of bucket 8
+    pool = np.asarray(paged_prefill_write(pool, table_row, 5, vals))
+    # 5 real positions land through the table...
+    assert pool[2].sum() == 4 * kvh * d
+    assert pool[4, 0].sum() == kvh * d
+    assert pool[4, 1:].sum() == 0.0
+    # ...and the 3 pad positions hit ONLY the null sink (block 0)
+    assert pool[[1, 3, 5]].sum() == 0.0
+
+
+def test_null_block_absorbs_inactive_writes():
+    """An inactive slot's write lands in block 0 and paged_gather of a
+    real table never reads it back."""
+    bs, kvh, d = 2, 1, 2
+    pool = jnp.zeros((4, bs, kvh, d), jnp.float32)
+    tables = np.array([[1], [2]], np.int32)
+    blk, off = slot_coords(tables, np.array([0, 0], np.int32), bs,
+                           active=np.array([True, False]))
+    vals = np.full((2, kvh, d), 7.0, np.float32)
+    pool = np.asarray(paged_write(pool, blk, off, vals))
+    assert pool[1, 0].sum() == kvh * d * 7.0   # the live slot's write
+    assert pool[2].sum() == 0.0                # inactive slot's block clean
+    assert pool[0, 0].sum() == kvh * d * 7.0   # absorbed by the sink
+    got = np.asarray(paged_gather(pool, tables))
+    assert got[1].sum() == 0.0  # the sink never leaks into a real read
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def test_env_knob_defaults_and_floors(monkeypatch):
+    from mxnet_tpu.serving import kvcache_block_size, kvcache_blocks
+
+    monkeypatch.delenv("MXTPU_KVCACHE_BLOCKS", raising=False)
+    monkeypatch.delenv("MXTPU_KVCACHE_BLOCK_SIZE", raising=False)
+    assert kvcache_blocks() == 512
+    assert kvcache_block_size() == 16
+    monkeypatch.setenv("MXTPU_KVCACHE_BLOCKS", "1")
+    assert kvcache_blocks() == 2  # block 0 is the sink: need >= 1 usable
+    monkeypatch.setenv("MXTPU_KVCACHE_BLOCKS", "64")
+    monkeypatch.setenv("MXTPU_KVCACHE_BLOCK_SIZE", "8")
+    c = PagedKVCache(1, 1, 2, max_seq=32)
+    assert c.num_blocks == 64 and c.block_size == 8
+    assert c.max_blocks_per_seq == 4

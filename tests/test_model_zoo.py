@@ -39,6 +39,7 @@ def test_resnet18_v1_forward_backward():
     assert all(np.isfinite(g.asnumpy()).all() for g in grads)
 
 
+@pytest.mark.slow
 def test_resnet34_v2():
     _smoke("resnet34_v2", input_size=64)
 
@@ -50,34 +51,42 @@ def test_resnet50_v1_shape():
     assert out.shape == (1, 7)
 
 
+@pytest.mark.slow
 def test_alexnet():
     _smoke("alexnet", input_size=224)
 
 
+@pytest.mark.slow
 def test_vgg11():
     _smoke("vgg11", input_size=224)
 
 
+@pytest.mark.slow
 def test_vgg11_bn():
     _smoke("vgg11_bn", input_size=224)
 
 
+@pytest.mark.slow
 def test_squeezenet():
     _smoke("squeezenet1.1", input_size=224)
 
 
+@pytest.mark.slow
 def test_densenet121():
     _smoke("densenet121", input_size=64)
 
 
+@pytest.mark.slow
 def test_mobilenet():
     _smoke("mobilenet0.25", input_size=64)
 
 
+@pytest.mark.slow
 def test_mobilenet_v2():
     _smoke("mobilenetv2_0.25", input_size=64)
 
 
+@pytest.mark.slow
 def test_inception_v3():
     _smoke("inceptionv3", input_size=299)
 

@@ -33,6 +33,7 @@ def _make_stages(S, seed=0):
             for _ in range(S)]
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential():
     S, B = 8, 8
     mesh = parallel.make_mesh({"pp": S})
@@ -48,6 +49,7 @@ def test_pipeline_matches_sequential():
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential():
     S, B = 8, 8
     mesh = parallel.make_mesh({"pp": S})

@@ -390,6 +390,7 @@ def _mini_resnet(classes=4):
     return net
 
 
+@pytest.mark.slow
 def test_quantize_net_resnet_residuals_stay_int8():
     """VERDICT r4 #4: quantize_net on a ResNet topology keeps the
     skip-adds int8 end-to-end (quantized_elemwise_add), and int8

@@ -87,6 +87,7 @@ def test_sparse_embedding_grad_and_kvstore():
     assert_almost_equal(out_buf.asnumpy()[1], emb.weight.data().asnumpy()[1])
 
 
+@pytest.mark.slow
 def test_factorization_machine_convergence():
     """Tiny FM on synthetic sparse data (BASELINE config #4)."""
     from mxnet_tpu import autograd, gluon

@@ -20,6 +20,10 @@ order:
 6. Serving AOT buckets (``serving[...]``) + the int8
    :class:`~mxnet_tpu.contrib.quantization.QuantizedNet` engine, whose
    stage payloads are the SANCTIONED baked constants.
+7. Generation fast path: one tiny greedy generation through a
+   :class:`~mxnet_tpu.serving.GenerationEngine` registers the sealed
+   chunk-of-T decode loop (``decode_chunk`` — contract-pinned: it must
+   stay collective-free) and a prefill bucket (``decode_prefill[...]``).
 
 Everything is fixed-seed and fixed-shape, so site names and collective
 signatures are deterministic run to run. This module imports jax —
@@ -205,12 +209,26 @@ def collect_records(steps=2):
         finally:
             qeng.close()
 
+    def leg_decode():
+        from mxnet_tpu.serving import GenerationEngine, TransformerDecoderLM
+
+        eng = GenerationEngine(
+            TransformerDecoderLM(vocab_size=32, num_layers=1, d_model=16,
+                                 num_heads=2, max_seq=32, seed=0),
+            shapes=[4], slots=2, chunk=2, cache_blocks=16,
+            cache_block_size=4, name="graphcheck-gen")
+        try:
+            eng.predict(np.array([1, 2, 3], np.int32),
+                        max_new_tokens=3, greedy=True, timeout=60.0)
+        finally:
+            eng.close()
+
     prev_hook = introspect.set_graph_hook(hook)
     prev_enabled = introspect.set_enabled(True)
     introspect.reset()
     try:
         for leg in (leg_amp, leg_plain, leg_superstep, leg_spmd,
-                    leg_kvstore, leg_serving):
+                    leg_kvstore, leg_serving, leg_decode):
             introspect.reset()
             leg()
     finally:

@@ -28,14 +28,15 @@ from ..engine import Finding, Rule, register
 #: each prefixed family) — the tier-1 smoke asserts against this so a
 #: silently-skipped harness leg cannot fake green
 CANONICAL_SITES = ("trainer_fused", "superstep", "spmd_step",
-                   "spmd_superstep", "kv_bucket")
-CANONICAL_PREFIXES = ("cachedop_fwd[", "cachedop_bwd[", "serving[", "op[")
+                   "spmd_superstep", "kv_bucket", "decode_chunk")
+CANONICAL_PREFIXES = ("cachedop_fwd[", "cachedop_bwd[", "serving[", "op[",
+                      "decode_prefill[")
 
 #: sites whose collective signature is ALWAYS pinned in
 #: graph_contracts.json, even when (today) it is empty — adding a
 #: collective to one of these is a contract change, not a drive-by
 SPMD_SITES = ("spmd_step", "spmd_superstep", "kv_bucket",
-              "kv_bucket_pack")
+              "kv_bucket_pack", "decode_chunk")
 
 _COLLECTIVE_PRIMS = frozenset({
     "psum", "psum2", "psum_scatter", "reduce_scatter", "all_gather",

@@ -60,6 +60,18 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._admit"),
     ("mxnet_tpu/serving/batcher.py", "ContinuousBatcher._next_wake"),
     ("mxnet_tpu/serving/engine.py", "InferenceEngine._execute"),
+    # generation fast path: the decode scheduler loop runs between
+    # every chunk dispatch (inter-token latency IS the SLO — the ONE
+    # deliberate sync per chunk materializes the sampled tokens) and
+    # the paged-cache allocator sits on the admission path
+    ("mxnet_tpu/serving/generation.py", "GenerationEngine._loop"),
+    ("mxnet_tpu/serving/generation.py", "GenerationEngine._admit"),
+    ("mxnet_tpu/serving/generation.py", "GenerationEngine._prefill"),
+    ("mxnet_tpu/serving/generation.py", "GenerationEngine._step_chunk"),
+    ("mxnet_tpu/serving/kvcache.py", "PagedKVCache.allocate"),
+    ("mxnet_tpu/serving/kvcache.py", "PagedKVCache.ensure"),
+    ("mxnet_tpu/serving/kvcache.py", "PagedKVCache.fork"),
+    ("mxnet_tpu/serving/kvcache.py", "PagedKVCache.release"),
     # cluster observability plane: the federation publisher snapshots
     # the registry off-thread and the watchdog loop reads already-
     # emitted series — neither may add a dispatch or an unmarked sync
