@@ -1688,6 +1688,237 @@ def bench_overlap(backend):
         f.write("\n")
 
 
+def _parallel4d_run():
+    """The composed 4D-parallel measurement body — requires an
+    8-device JAX context (the single-device CPU default spawns a
+    forced-8-device child via ``bench_parallel4d``). Sweeps (dp, pp,
+    tp, ep) layouts of the SAME model through ``Composed4DStep``,
+    pinning loss parity against the pure-dp leg, the measured
+    schedule bubbles, the MoE all-to-all overlap probe, and each
+    config's per-device memory."""
+    import time as _time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu import parallel
+
+    ndev = len(jax.devices())
+    L = int(os.environ.get("BENCH_P4D_STAGES", "4"))
+    D = int(os.environ.get("BENCH_P4D_WIDTH", "64"))
+    B = int(os.environ.get("BENCH_P4D_BATCH", "64"))
+    M = int(os.environ.get("BENCH_P4D_MICROBATCH", "8"))
+    steps = int(os.environ.get("BENCH_P4D_STEPS", "8"))
+    parity_steps = 5
+    rng = np.random.RandomState(0)
+    W0 = (rng.randn(L, D, D) * 0.3).astype(np.float32)
+    b0 = (rng.randn(L, D) * 0.1).astype(np.float32)
+    X = rng.randn(B, D).astype(np.float32)
+    Y = rng.randn(B, D).astype(np.float32)
+
+    def stage_fn(p, h):
+        W, b = p
+        return jnp.tanh(h @ W + b)
+
+    def stage_fn_tp(p, h):
+        W, b = p
+        out = parallel.tp_copy(h, "tp") @ W
+        return jnp.tanh(parallel.tp_all_gather(out, "tp", axis=1) + b)
+
+    def loss_fn(o, y):
+        return jnp.mean((o - y) ** 2)
+
+    def leg(name, axes, used, schedule=None, zero=0, tp=False):
+        mesh = parallel.composed_mesh(devices=jax.devices()[:used],
+                                      **axes)
+        step = parallel.Composed4DStep(
+            stage_fn_tp if tp else stage_fn,
+            (jnp.asarray(W0), jnp.asarray(b0)), mesh, loss_fn,
+            optimizer="adam", num_microbatches=M, schedule=schedule,
+            zero_stage=zero,
+            tp_specs=(P(None, "tp"), P()) if tp else None)
+        losses = [float(step(X, Y, lr=1e-3))
+                  for _ in range(parity_steps)]
+        loss = step(X, Y, lr=1e-3)  # warm timing path
+        jax.block_until_ready(loss)
+        t0 = _time.perf_counter()
+        for _ in range(steps):
+            loss = step(X, Y, lr=1e-3)
+        jax.block_until_ready(loss)
+        dt = (_time.perf_counter() - t0) / steps
+        return {"name": name, "axes": axes, "zero_stage": zero,
+                "schedule": step.schedule.name, "losses": losses,
+                "step_seconds": dt, "report": step.schedule_report(),
+                "memory": step.memory_report()}
+
+    legs = [
+        leg("dp8", {"dp": ndev}, ndev),
+        leg("dp2_pp4_gpipe", {"dp": 2, "pp": 4}, 8, schedule="gpipe"),
+        leg("dp2_pp4_1f1b", {"dp": 2, "pp": 4}, 8, schedule="1f1b"),
+        leg("dp2_pp2_tp2_il", {"dp": 2, "pp": 2, "tp": 2}, 8,
+            schedule="interleaved", tp=True),
+        leg("dp2_pp2_zero2", {"dp": 2, "pp": 2}, 4, zero=2),
+    ]
+    base = legs[0]["losses"]
+    for lg in legs[1:]:
+        lg["loss_max_diff_vs_dp"] = max(
+            abs(a - b) for a, b in zip(base, lg["losses"]))
+        if lg["loss_max_diff_vs_dp"] > 1e-4:
+            raise RuntimeError(
+                f"parallel4d parity broke: {lg['name']} diverged from "
+                f"pure-dp by {lg['loss_max_diff_vs_dp']}")
+
+    # schedule-level bubble probe at matched (S, M): the 1F1B-family
+    # win over fill-drain comes from virtual chunks — plain 1f1b
+    # matches gpipe's bubble and only shrinks the activation stash
+    probe = parallel.measure_pipeline_bubble(2, M, virtual=2)
+    gp = probe["gpipe"]["bubble_fraction"]
+    il = probe["interleaved"]["bubble_fraction"]
+    if not il < gp:
+        raise RuntimeError(
+            f"interleaved bubble {il} not below gpipe {gp}")
+    if 1.0 - il < 0.9:
+        raise RuntimeError(
+            f"pipeline overlap {1.0 - il} below the 0.9 gate")
+
+    moe = parallel.measure_moe_overlap(
+        parallel.composed_mesh(ep=ndev), d_model=32, d_hidden=64,
+        steps=6, warmup=2)
+    return {"devices": ndev,
+            "config": {"stages": L, "width": D, "batch": B,
+                       "microbatches": M, "steps": steps},
+            "legs": legs, "bubble_probe": probe,
+            "pipeline_overlap_fraction": 1.0 - il,
+            "moe": moe}
+
+
+def _parallel4d_main():
+    """Child-process entry (see ``_overlap_probe_main``)."""
+    print(json.dumps({"parallel4d": _parallel4d_run()}), flush=True)
+
+
+def bench_parallel4d(backend):
+    """PR19 tentpole: the 4D-parallel composed trainer. Sweeps (dp,
+    pp, tp) layouts of one model through ``Composed4DStep`` —
+    loss-parity-pinned against pure dp — and measures the realized
+    schedule bubbles (interleaved-1F1B strictly below fill-drain
+    GPipe at the same microbatch count, >=90% pipeline overlap), the
+    MoE all-to-all overlap probe, and per-config memory/bubble
+    reports. Emits BENCH_pr19.json."""
+    import subprocess
+
+    import jax
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if len(jax.devices()) >= 8:
+        data = _parallel4d_run()
+    else:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
+                         if "host_platform_device_count" not in f)
+        env["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
+        code = ("import sys; sys.path.insert(0, %r); import jax; "
+                "jax.config.update('jax_platforms', 'cpu'); "
+                "import bench; bench._parallel4d_main()" % root)
+        res = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True, timeout=540)
+        if res.returncode != 0:
+            raise RuntimeError(
+                f"parallel4d child failed rc={res.returncode}: "
+                f"{res.stderr[-1500:]}")
+        lines = [ln for ln in res.stdout.splitlines()
+                 if ln.startswith('{"parallel4d"')]
+        if not lines:
+            raise RuntimeError(
+                f"parallel4d child printed no result: "
+                f"{res.stdout[-800:]}")
+        data = json.loads(lines[-1])["parallel4d"]
+
+    ndev = data["devices"]
+    no_flops = ("parallel4d measures schedule occupancy, parity and "
+                "memory layout, not FLOPs")
+    for lg in data["legs"]:
+        rep = lg["report"]
+        mem = lg["memory"]
+        _emit(f"parallel4d_{lg['name']}_{ndev}dev_{backend}",
+              1.0 / lg["step_seconds"], "steps/sec", None,
+              step_ms=lg["step_seconds"] * 1e3,
+              schedule=lg["schedule"],
+              bubble_fraction=rep["bubble_fraction"],
+              stash_slots=rep["stash_slots"],
+              ticks=rep["ticks"],
+              zero_stage=lg["zero_stage"],
+              loss_max_diff_vs_dp=lg.get("loss_max_diff_vs_dp", 0.0),
+              param_bytes_per_device=mem["param_bytes_per_device"],
+              opt_bytes_per_device=mem["opt_bytes_per_device"],
+              flops_per_step=None, mfu=None, mfu_reason=no_flops)
+    probe = data["bubble_probe"]
+    _emit(f"parallel4d_pipeline_overlap_fraction_{backend}",
+          data["pipeline_overlap_fraction"], "fraction", None,
+          target_fraction=0.9,
+          gpipe_bubble_fraction=probe["gpipe"]["bubble_fraction"],
+          f1b_bubble_fraction=probe["1f1b"]["bubble_fraction"],
+          interleaved_bubble_fraction=probe["interleaved"][
+              "bubble_fraction"],
+          gpipe_stash_slots=probe["gpipe"]["stash_slots"],
+          f1b_stash_slots=probe["1f1b"]["stash_slots"],
+          flops_per_step=None, mfu=None, mfu_reason=no_flops)
+    moe = data["moe"]
+    _emit(f"parallel4d_moe_a2a_hidden_fraction_{backend}",
+          moe["hidden_fraction"], "fraction", None,
+          exposed_chunked_ms=moe["exposed"]["chunked"] * 1e3,
+          exposed_serial_ms=moe["exposed"]["serial"] * 1e3,
+          flops_per_step=None, mfu=None, mfu_reason=no_flops)
+    # curated trajectory record: deterministic contract values are
+    # gate-checked by bench_diff; run-noisy values (CPU timings,
+    # float-roundoff parity diffs) carry the informational _ prefix
+    legs = {}
+    for lg in data["legs"]:
+        rep = lg["report"]
+        mem = lg["memory"]
+        legs[lg["name"]] = {
+            "schedule": lg["schedule"],
+            "zero_stage": lg["zero_stage"],
+            "bubble_fraction": rep["bubble_fraction"],
+            "stash_slots": rep["stash_slots"],
+            "ticks": rep["ticks"],
+            "param_bytes_per_device": mem["param_bytes_per_device"],
+            "opt_bytes_per_device": mem["opt_bytes_per_device"],
+            "_step_ms": round(lg["step_seconds"] * 1e3, 3),
+            "_loss_max_diff_vs_dp": lg.get("loss_max_diff_vs_dp", 0.0),
+        }
+    record = {
+        "scenario": "parallel4d", "backend": backend,
+        "devices": ndev, "config": data["config"],
+        "loss_parity_ok": 1,  # _parallel4d_run raises otherwise
+        "pipeline_overlap_fraction": data["pipeline_overlap_fraction"],
+        "gpipe_bubble_fraction": probe["gpipe"]["bubble_fraction"],
+        "f1b_bubble_fraction": probe["1f1b"]["bubble_fraction"],
+        "interleaved_bubble_fraction": probe["interleaved"][
+            "bubble_fraction"],
+        "gpipe_stash_slots": probe["gpipe"]["stash_slots"],
+        "f1b_stash_slots": probe["1f1b"]["stash_slots"],
+        "legs": legs,
+        "_moe_a2a_hidden_fraction": moe["hidden_fraction"],
+        "_moe_a2a_exposed_chunked_ms": round(
+            moe["exposed"]["chunked"] * 1e3, 4),
+        "_moe_a2a_exposed_serial_ms": round(
+            moe["exposed"]["serial"] * 1e3, 4),
+        "flops_per_step": None, "mfu": None, "mfu_reason": no_flops,
+    }
+    out_path = os.environ.get(
+        "BENCH_PR19_OUT",
+        os.path.join(root, "BENCH_pr19.json"))
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+
+
 def _elastic_probe_run():
     """The live-elasticity measurement body — requires a >=4-device JAX
     context (the single-device CPU default spawns a forced-4-device
@@ -2452,6 +2683,7 @@ def main():
              ("decode", bench_decode),
              ("fleet", bench_fleet),
              ("federation", bench_federation),
+             ("parallel4d", bench_parallel4d),
              ("bert", bench_bert),
              ("resnet", bench_resnet)]  # resnet LAST: tail = headline
     completed, failed = [], {}

@@ -3,7 +3,11 @@
 
 Demonstrates the full TPU-native parallelism stack: tensor-parallel
 sharding map + data-parallel batch sharding in one fused train step, with
-ring attention available for long sequences.
+ring attention available for long sequences. ``--pp N`` switches to the
+composed 4D executor (``parallel.Composed4DStep``): the decoder layers
+run as pipeline stages over a (dp, pp, tp) mesh with a 1F1B-family
+schedule, the MLP tensor-parallel via the Megatron f/g bracket, and the
+embedding/head trained as replicated extras.
 """
 
 import argparse
@@ -19,6 +23,146 @@ import mxnet_tpu as mx
 from mxnet_tpu import gluon, models, parallel
 
 
+def _composed_pp_main(args, net):
+    """The --pp path: stack the decoder layers into [L, ...] stage
+    leaves pulled from the initialized gluon model and drive them
+    through Composed4DStep on a composed (dp, pp, tp) mesh."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    cfg = net._cfg
+    C, I = cfg["units"], cfg["intermediate"]
+    H, KVH = cfg["num_heads"], cfg["num_kv_heads"]
+    Dh = C // H
+    L = cfg["num_layers"]
+    V = cfg["vocab_size"]
+    tp = args.tp
+
+    ndev = len(jax.devices())
+    dp = ndev // (args.pp * tp)
+    if dp < 1 or dp * args.pp * tp != ndev:
+        raise SystemExit(f"--pp {args.pp} --tp {tp} does not tile "
+                         f"{ndev} devices")
+    mesh = parallel.composed_mesh(dp=dp, pp=args.pp, tp=tp)
+
+    # gluon defers shape inference to the first forward — run one tiny
+    # batch so every parameter is materialized before we stack them
+    net(mx.nd.array(np.zeros((1, 4), np.float32)))
+    blocks = net.collect_params()
+
+    def leaf(suffix):
+        for name, p in blocks.items():
+            if name.endswith(suffix):
+                return p.data().asnumpy().astype(np.float32)
+        raise KeyError(suffix)
+
+    def stack(fmt):
+        return jnp.asarray(np.stack([leaf(fmt.format(i))
+                                     for i in range(L)]))
+
+    stage_params = (
+        stack("l{}_in_ln_weight"),     # [L, C]
+        stack("l{}_attn_q_weight"),    # [L, H*Dh, C]  (out, in)
+        stack("l{}_attn_k_weight"),    # [L, KVH*Dh, C]
+        stack("l{}_attn_v_weight"),
+        stack("l{}_attn_o_weight"),    # [L, C, C]
+        stack("l{}_post_ln_weight"),   # [L, C]
+        stack("l{}_mlp_gate_weight"),  # [L, I, C]
+        stack("l{}_mlp_up_weight"),    # [L, I, C]
+        stack("l{}_mlp_down_weight"),  # [L, C, I]
+    )
+    # Megatron MLP bracket: gate/up column-parallel (out dim over tp,
+    # intermediate gathered back), attention + down replicated
+    tp_specs = (P(), P(), P(), P(), P(), P(),
+                P("tp", None), P("tp", None), P()) if tp > 1 else None
+
+    def rms(x, w, eps=1e-5):
+        var = jnp.mean(x * x, axis=-1, keepdims=True)
+        return x * (1.0 / jnp.sqrt(var + eps)) * w
+
+    def rope(x, base=500000.0):
+        B, nH, T, D = x.shape
+        half = D // 2
+        freqs = 1.0 / (base ** (jnp.arange(0, half, dtype=jnp.float32)
+                                / half))
+        ang = jnp.einsum("t,f->tf", jnp.arange(T, dtype=jnp.float32),
+                         freqs)
+        cos = jnp.cos(ang)[None, None]
+        sin = jnp.sin(ang)[None, None]
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([x1 * cos - x2 * sin,
+                                x2 * cos + x1 * sin], axis=-1)
+
+    def stage_fn(p, h):
+        ln1, qw, kw, vw, ow, ln2, gw, uw, dw = p
+        B, T, _ = h.shape
+        a = rms(h, ln1)
+        q = (a @ qw.T).reshape(B, T, H, Dh).transpose(0, 2, 1, 3)
+        k = (a @ kw.T).reshape(B, T, KVH, Dh).transpose(0, 2, 1, 3)
+        v = (a @ vw.T).reshape(B, T, KVH, Dh).transpose(0, 2, 1, 3)
+        q, k = rope(q), rope(k)
+        rep = H // KVH
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+        att = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dh)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        att = jnp.where(causal[None, None], att, -1e30)
+        o = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(att, axis=-1),
+                       v)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, C)
+        h = h + o @ ow.T
+        m = rms(h, ln2)
+        if tp > 1:
+            mc = parallel.tp_copy(m, "tp")
+            mid = jax.nn.silu(mc @ gw.T) * (mc @ uw.T)
+            mid = parallel.tp_all_gather(mid, "tp", axis=-1)
+        else:
+            mid = jax.nn.silu(m @ gw.T) * (m @ uw.T)
+        return h + mid @ dw.T
+
+    embed_params = (jnp.asarray(leaf("embed_weight")),)      # [V, C]
+    head_params = (jnp.asarray(leaf("norm_weight")),
+                   jnp.asarray(leaf("lm_head_weight")))      # [V, C]
+
+    def embed_fn(pe, ids):
+        return pe[0][ids.astype(jnp.int32)]
+
+    def head_fn(ph, h):
+        return rms(h, ph[0]) @ ph[1].T
+
+    def lm_loss(logits, labels):
+        flat = logits.reshape(-1, V)
+        lab = labels.reshape(-1).astype(jnp.int32)
+        logp = jax.nn.log_softmax(flat)
+        return -jnp.mean(jnp.take_along_axis(logp, lab[:, None],
+                                             axis=1))
+
+    step = parallel.Composed4DStep(
+        stage_fn, stage_params, mesh, lm_loss, optimizer="adam",
+        zero_stage=args.zero, tp_specs=tp_specs,
+        embed_fn=embed_fn, embed_params=embed_params,
+        head_fn=head_fn, head_params=head_params)
+
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, V, (args.batch_size, args.seq_len + 1))
+    x = tokens[:, :-1].astype(np.int32)
+    y = tokens[:, 1:].astype(np.int32)
+    first = float(step(x, y, lr=args.lr))  # compile
+    tic = time.time()
+    for _ in range(args.steps):
+        loss = float(step(x, y, lr=args.lr))
+    dt = time.time() - tic
+    tok_s = args.batch_size * args.seq_len * args.steps / dt
+    rep = step.schedule_report()
+    print(f"mesh=(dp={dp},pp={args.pp},tp={tp}) "
+          f"schedule={rep['schedule']} "
+          f"bubble={rep['bubble_fraction']:.3f} zero={args.zero}")
+    print(f"loss={loss:.4f} (first {first:.4f})  tokens/sec={tok_s:.0f}")
+    if not loss < first:
+        raise SystemExit("composed step did not reduce the loss")
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--config", default="llama_tiny",
@@ -28,10 +172,26 @@ def main():
     parser.add_argument("--steps", type=int, default=20)
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--tp", type=int, default=1)
+    parser.add_argument("--pp", type=int, default=1,
+                        help="pipeline stages; >1 switches to the "
+                             "composed (dp, pp, tp) Composed4DStep path")
+    parser.add_argument("--zero", type=int, default=0,
+                        choices=[0, 1, 2, 3],
+                        help="ZeRO stage on the dp axis (--pp path)")
     parser.add_argument("--dtype", default="float32")
     args = parser.parse_args()
 
     import jax
+
+    net = models.get_llama(args.config)
+    net.initialize(init=mx.initializer.Normal(0.02))
+    if args.dtype != "float32":
+        net.cast(args.dtype)
+    vocab = net._cfg["vocab_size"]
+
+    if args.pp > 1:
+        _composed_pp_main(args, net)
+        return
 
     ndev = len(jax.devices())
     if args.tp > 1:
@@ -40,12 +200,6 @@ def main():
         mesh = parallel.make_mesh({"dp": ndev})
     else:
         mesh = None
-
-    net = models.get_llama(args.config)
-    net.initialize(init=mx.initializer.Normal(0.02))
-    if args.dtype != "float32":
-        net.cast(args.dtype)
-    vocab = net._cfg["vocab_size"]
 
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
@@ -62,7 +216,6 @@ def main():
     x = mx.nd.array(tokens[:, :-1].astype(np.float32))
     y = mx.nd.array(tokens[:, 1:].astype(np.float32))
     step(x, y, lr=args.lr)  # compile
-
     tic = time.time()
     for i in range(args.steps):
         loss = step(x, y, lr=args.lr, sync=(i == args.steps - 1))

@@ -153,6 +153,80 @@ def overlap_bucket_bytes() -> int:
     return int(v) if v else bucket_bytes()
 
 
+_PIPELINE_SCHEDULES = ("gpipe", "1f1b", "interleaved")
+
+
+def pipeline_schedule() -> str:
+    """Default pipeline schedule for ``PipelineTrainStep`` /
+    ``Composed4DStep`` (``MXTPU_PIPELINE_SCHEDULE``): ``gpipe``
+    (default — fill-drain, bubble (S-1)/(M+S-1), activation stash grows
+    with M), ``1f1b`` (same bubble, stash capped at the stage depth —
+    the memory schedule), ``interleaved`` (1F1B over v virtual stage
+    chunks per rank — divides the bubble by v; requires the stacked
+    stage count to be a multiple of the ``pp`` axis). Unknown values
+    warn once and fall back to ``gpipe``. See docs/performance.md
+    "choosing a 4D layout"."""
+    v = str(getenv("MXTPU_PIPELINE_SCHEDULE", "gpipe", dtype=str)
+            or "gpipe").lower()
+    if v not in _PIPELINE_SCHEDULES:
+        key = ("fusedstep", f"MXTPU_PIPELINE_SCHEDULE={v!r}")
+        if key not in _LOGGED:
+            _LOGGED.add(key)
+            _logger.warning("MXTPU_PIPELINE_SCHEDULE=%r is not one of %s; "
+                            "using 'gpipe'", v, _PIPELINE_SCHEDULES)
+        return "gpipe"
+    return v
+
+
+def pipeline_microbatches() -> int:
+    """Default microbatch count for the pipeline schedules
+    (``MXTPU_PIPELINE_MICROBATCHES``, default 0 = one per pipeline
+    stage). More microbatches shrink the fill/drain bubble
+    (bubble ~ (S-1)/(M+S-1)) at the cost of smaller per-microbatch
+    matmuls; see docs/performance.md "choosing a 4D layout"."""
+    return max(0, int(getenv("MXTPU_PIPELINE_MICROBATCHES", 0, dtype=int)))
+
+
+_MOE_ROUTERS = ("top1", "top2")
+
+
+def moe_router() -> str:
+    """Default MoE router (``MXTPU_MOE_ROUTER``): ``top1`` (default —
+    Switch-style, one expert per token) or ``top2`` (GShard-style, two
+    experts with normalized combine weights + the load-balancing aux
+    loss). Unknown values warn once and fall back to ``top1``."""
+    v = str(getenv("MXTPU_MOE_ROUTER", "top1", dtype=str) or "top1").lower()
+    if v not in _MOE_ROUTERS:
+        key = ("fusedstep", f"MXTPU_MOE_ROUTER={v!r}")
+        if key not in _LOGGED:
+            _LOGGED.add(key)
+            _logger.warning("MXTPU_MOE_ROUTER=%r is not one of %s; using "
+                            "'top1'", v, _MOE_ROUTERS)
+        return "top1"
+    return v
+
+
+def moe_capacity_factor() -> float:
+    """Default expert capacity factor (``MXTPU_MOE_CAPACITY_FACTOR``,
+    default 1.5): per-expert slot count = ceil(tokens/experts * factor).
+    Tokens past capacity drop to the residual path (output 0 for that
+    token's expert contribution) — raise for exactness, lower for
+    speed/memory. See docs/performance.md "choosing a 4D layout"."""
+    v = getenv("MXTPU_MOE_CAPACITY_FACTOR", None, dtype=float)
+    return float(v) if v else 1.5
+
+
+def moe_a2a_chunks() -> int:
+    """Expert-dispatch chunking for the in-graph MoE all-to-all
+    (``MXTPU_MOE_A2A_CHUNKS``, default 2): the capacity buffer splits
+    into this many chunks, each dispatched as its own ``all_to_all`` so
+    XLA's latency-hiding scheduler overlaps chunk k+1's wire time with
+    chunk k's expert FFN — the bucket-allreduce trick applied to expert
+    parallelism. 1 = single all-to-all (no overlap; the measurement
+    baseline)."""
+    return max(1, int(getenv("MXTPU_MOE_A2A_CHUNKS", 2, dtype=int)))
+
+
 def zero_stage() -> int:
     """Default ZeRO sharding stage for ``SPMDTrainStep``
     (``MXTPU_ZERO_STAGE``, default 0): 0 = replicated optimizer state,

@@ -283,6 +283,57 @@ def record_overlap_probe(exposed_by_mode, hidden_fraction):
     _attr.set_comm_hint(exposed_by_mode)
 
 
+PIPELINE_BUBBLE_FRACTION = _REGISTRY.gauge(
+    "mxtpu_pipeline_bubble_fraction",
+    "fraction of (rank, tick) slots with no scheduled work in the "
+    "realized pipeline schedule table, by schedule (gpipe / 1f1b / "
+    "interleaved) — measured from the dependency-simulated tick "
+    "program, not a closed-form estimate; 1 - bubble is the "
+    "pipeline-overlap criterion")
+PIPELINE_STASH_SLOTS = _REGISTRY.gauge(
+    "mxtpu_pipeline_stash_slots",
+    "peak live forward-activation stash entries on any pipeline rank, "
+    "by schedule — the 1F1B memory win over fill-drain gpipe is this "
+    "gauge dropping from ~M (microbatches) to ~S (stages)")
+MOE_A2A_EXPOSED_SECONDS = _REGISTRY.gauge(
+    "mxtpu_moe_a2a_exposed_seconds",
+    "per-step wall time of the MoE all-to-all NOT hidden behind expert "
+    "compute, by dispatch mode (serial / chunked; step time minus the "
+    "comm-free probe's — set by measure_moe_overlap)")
+MOE_A2A_HIDDEN_FRACTION = _REGISTRY.gauge(
+    "mxtpu_moe_a2a_hidden_fraction",
+    "fraction of the serial baseline's exposed all-to-all time the "
+    "chunked (comm/compute interleaved) MoE dispatch hides "
+    "(1 - exposed_chunked/exposed_serial, from measure_moe_overlap)")
+
+
+def record_pipeline_schedule(schedule, bubble_fraction, stash_slots,
+                             ticks=None):
+    """Publish a realized pipeline schedule's measured shape (bubble +
+    stash depth gauges, by schedule) and drop a ``pipeline.schedule``
+    instant on the trace so mxtpu-doctor can join it with step-phase
+    attribution."""
+    PIPELINE_BUBBLE_FRACTION.set(float(bubble_fraction),
+                                 schedule=str(schedule))
+    PIPELINE_STASH_SLOTS.set(float(stash_slots), schedule=str(schedule))
+    _TRACER.instant("pipeline.schedule", cat="parallel",
+                    schedule=str(schedule),
+                    bubble_fraction=float(bubble_fraction),
+                    stash_slots=int(stash_slots),
+                    ticks=int(ticks) if ticks is not None else None)
+
+
+def record_moe_probe(exposed_by_mode, hidden_fraction):
+    """Publish a MoE all-to-all overlap measurement (exposed seconds
+    per dispatch mode + the hidden fraction)."""
+    for mode, sec in (exposed_by_mode or {}).items():
+        MOE_A2A_EXPOSED_SECONDS.set(float(sec), mode=str(mode))
+    if hidden_fraction is not None:
+        MOE_A2A_HIDDEN_FRACTION.set(float(hidden_fraction))
+    _TRACER.instant("moe.a2a_probe", cat="parallel",
+                    hidden_fraction=float(hidden_fraction or 0.0))
+
+
 AMP_LOSS_SCALE = _REGISTRY.gauge(
     "mxtpu_amp_loss_scale",
     "current dynamic loss scale (fp16 AMP); under the fused step this "

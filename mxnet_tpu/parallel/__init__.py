@@ -7,7 +7,9 @@ sharding-annotated fused train step. Data parallel ≈ batch-axis sharding
 annotations on the same step (P9/P13 in SURVEY.md §2.5).
 """
 
-from .mesh import make_mesh, current_mesh, data_parallel_mesh  # noqa: F401
+from .mesh import (make_mesh, current_mesh, data_parallel_mesh,  # noqa: F401
+                   composed_mesh, axis_size, validate_mesh_axes,
+                   MESH_AXES)
 from .spmd import (SPMDTrainStep, shard_batch, replicate,  # noqa: F401
                    bucketed_psum,  # noqa: F401
                    spmd_save_states, spmd_load_states,  # noqa: F401
@@ -18,5 +20,11 @@ from .overlap import (BucketPlan, build_bucket_plan,  # noqa: F401
                       first_use_order, measure_overlap)
 from .ring_attention import ring_attention, shard_sequence  # noqa: F401
 from .pipeline import (PipelineTrainStep, pipeline_apply,  # noqa: F401,E402
-                       shard_stages, stack_stage_params)
+                       shard_stages, stack_stage_params,
+                       build_pipeline_schedule, stage_permutation,
+                       measure_pipeline_bubble)
+from .composed import (Composed4DStep, tp_copy,  # noqa: F401,E402
+                       tp_all_gather)
 from . import moe  # noqa: F401,E402
+from .moe import (top2_routing, moe_apply_a2a,  # noqa: F401,E402
+                  measure_moe_overlap)

@@ -1,4 +1,15 @@
-"""Device mesh helpers."""
+"""Device mesh helpers.
+
+The 4D-parallel trainer composes every parallelism axis over ONE mesh
+whose axis names come from a fixed contract (``MESH_AXES``): ``dp``
+(data/batch — gradients reduce here, ZeRO shards optimizer state here),
+``pp`` (pipeline stages), ``tp`` (tensor/model sharding inside a
+stage), ``sp`` (sequence — ring attention), ``ep`` (MoE experts).
+``composed_mesh`` builds a canonically-ordered mesh from per-axis
+sizes; every consumer (``SPMDTrainStep``, ``Composed4DStep``, the MoE
+all-to-all, ring attention) addresses axes by these names only, so the
+axes stay orthogonal by construction.
+"""
 
 from __future__ import annotations
 
@@ -8,6 +19,10 @@ import jax
 from jax.sharding import Mesh
 
 _CURRENT = [None]
+
+#: The axis-name contract, in canonical order: data, pipeline, tensor,
+#: sequence, expert. A mesh may carry any subset (missing = size 1).
+MESH_AXES = ("dp", "pp", "tp", "sp", "ep")
 
 
 def make_mesh(axes=None, devices=None):
@@ -35,6 +50,46 @@ def make_mesh(axes=None, devices=None):
     dev_array = _np.array(devices).reshape(sizes)
     mesh = Mesh(dev_array, tuple(names))
     _CURRENT[0] = mesh
+    return mesh
+
+
+def composed_mesh(dp=1, pp=1, tp=1, sp=1, ep=1, devices=None):
+    """Build the canonical 4D-parallel mesh ``(dp, pp, tp, sp, ep)``.
+
+    Axes are ordered per ``MESH_AXES`` regardless of call order; size-1
+    axes are kept in the mesh so SPMD programs can name them uniformly
+    (a collective over a size-1 axis is a no-op). ``dp=-1`` infers the
+    data axis from the device count.
+    """
+    sizes = {"dp": dp, "pp": pp, "tp": tp, "sp": sp, "ep": ep}
+    for name, s in sizes.items():
+        if name != "dp" and (not isinstance(s, int) or s < 1):
+            raise ValueError(f"composed_mesh: axis {name}={s!r} must be "
+                             "a positive int (-1 inference is dp-only)")
+    return make_mesh({name: sizes[name] for name in MESH_AXES},
+                     devices=devices)
+
+
+def axis_size(mesh, name):
+    """Size of ``name`` in ``mesh`` (1 when the axis is absent)."""
+    return int(mesh.shape[name]) if name in mesh.shape else 1
+
+
+def validate_mesh_axes(mesh, where="mesh"):
+    """Loudly reject axis names outside the ``MESH_AXES`` contract.
+
+    Returns the mesh for chaining. Legacy single-purpose names used by
+    tests and internal probes (``batch``, ``model``, ``x``/``y``) stay
+    accepted — the contract governs the composed trainer path.
+    """
+    legacy = {"batch", "model", "x", "y", "devices"}
+    unknown = [a for a in mesh.axis_names
+               if a not in MESH_AXES and a not in legacy]
+    if unknown:
+        raise ValueError(
+            f"{where}: unknown mesh axes {unknown}; the 4D-parallel "
+            f"contract is {MESH_AXES} (see docs/performance.md "
+            "\"choosing a 4D layout\")")
     return mesh
 
 

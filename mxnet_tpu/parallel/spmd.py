@@ -158,6 +158,43 @@ def _lamb_rule(hyper):
     return init, update
 
 
+def _lamb_rule_sharded(hyper, axis_name):
+    """lamb over a flat dp-shard (ZeRO-2/3): identical math to
+    :func:`_lamb_rule` except the trust-ratio norms are computed as
+    local-shard sums of squares reduced with ONE extra psum pair over
+    the data axis — each flat array is one parameter, and its pad
+    region is zeros in both w and r, so the reduced norms are the
+    whole-parameter norms. This is what lets lamb keep stage 2/3
+    instead of declining to stage 1."""
+    beta1 = hyper.get("beta1", 0.9)
+    beta2 = hyper.get("beta2", 0.999)
+    eps = hyper.get("epsilon", 1e-6)
+    wd_const = hyper.get("wd", 0.0)
+
+    def init(w):
+        dt = _state_dtype(w)
+        return (jnp.zeros(w.shape, dt), jnp.zeros(w.shape, dt),
+                jnp.zeros((), jnp.int32))
+
+    def update(w, g, state, lr, wd=wd_const):
+        dt = _state_dtype(w)
+        m, v, t = state
+        t = t + 1
+        w32, g32, lr32 = w.astype(dt), g.astype(dt), lr.astype(dt)
+        m = beta1 * m + (1 - beta1) * g32
+        v = beta2 * v + (1 - beta2) * jnp.square(g32)
+        tf = t.astype(dt)
+        m_hat = m / (1 - beta1 ** tf)
+        v_hat = v / (1 - beta2 ** tf)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * w32
+        w_norm = jnp.sqrt(jax.lax.psum(jnp.sum(w32 * w32), axis_name))
+        r_norm = jnp.sqrt(jax.lax.psum(jnp.sum(r * r), axis_name))
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (w32 - lr32 * ratio * r).astype(w.dtype), (m, v, t)
+
+    return init, update
+
+
 def _nag_rule(hyper):
     """Nesterov momentum, matching ``optimizer.NAG.update``."""
     mom = hyper.get("momentum", 0.0)
@@ -275,6 +312,15 @@ class SPMDTrainStep:
                  compression_params=None):
         self.block = block
         self.loss_fn = loss_fn
+        if mesh is not None:
+            from .mesh import validate_mesh_axes, axis_size
+            validate_mesh_axes(mesh, "SPMDTrainStep")
+            if axis_size(mesh, "pp") > 1:
+                raise MXNetError(
+                    "SPMDTrainStep shards data/tensor axes only; a "
+                    f"pp={axis_size(mesh, 'pp')} mesh needs the "
+                    "pipeline executor — use Composed4DStep (or "
+                    "PipelineTrainStep for pp alone)")
         self.mesh = mesh
         self.batch_axis = batch_axis
         hyper = dict(optimizer_params or {})
@@ -299,14 +345,11 @@ class SPMDTrainStep:
         if int(zero_stage) not in (0, 1, 2, 3):
             raise MXNetError(f"zero_stage must be 0-3, got {zero_stage}")
         self.zero_stage = int(zero_stage)
-        if self.zero_stage >= 2 and optimizer == "lamb":
-            # lamb's trust ratio needs whole-parameter norms, which a
-            # flat-sharded update would have to psum per param — decline
-            # to stage 1 rather than quietly change the optimizer math
-            _fusedstep.log_fallback(
-                "spmd", "lamb has no sharded-update rule; ZeRO stage "
-                f"{self.zero_stage} downgraded to 1")
-            self.zero_stage = 1
+        # lamb + ZeRO-2/3: the overlap build swaps in _lamb_rule_sharded
+        # (shard-local trust-ratio norms + one psum pair), so the stage
+        # is kept — the factory inputs are stashed for that rebuild
+        self._hyper = hyper
+        self._multi_precision = bool(multi_precision)
         self._shard_opt_states = shard_opt_states or self.zero_stage == 1
         self._overlap_explicit = overlap is not None
         if overlap is None:
@@ -382,10 +425,12 @@ class SPMDTrainStep:
             return _jit("ZeRO-1")
         if self._nontrivial_sharding():
             if self.zero_stage >= 2:
-                _fusedstep.log_fallback(
-                    "spmd", "ZeRO-2/3 needs replicated param_sharding "
-                    "(tensor-parallel specs found); using ZeRO-1")
-                self.zero_stage = 1
+                # dp-axis opt-state sharding composes with the tensor
+                # partition on the GSPMD path: each moment rides the
+                # param's tp spec extended along its first free
+                # dp-divisible dim (see _opt_state_spec) — GSPMD emits
+                # the equivalent reduce-scatter/allgather itself, so
+                # the stage-2 memory layout survives tp
                 self._shard_opt_states = True
             return _jit("tensor-parallel")
         if self._overlap_mode == "staged":
@@ -431,6 +476,28 @@ class SPMDTrainStep:
             return pspec
         dp = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get(
             self.batch_axis)
+        if dp and len(pspec) > 0 and any(s is not None for s in pspec):
+            # tensor-parallel param: compose the dp shard ORTHOGONALLY —
+            # extend the tp spec along the first free dp-divisible dim
+            # (the tp split already divides sharded dims, so the check
+            # uses the tp-local extent)
+            dims = list(pspec) + [None] * (raw.ndim - len(pspec))
+            sizes = dict(zip(self.mesh.axis_names,
+                             self.mesh.devices.shape))
+            for d in range(raw.ndim):
+                local = raw.shape[d] // sizes.get(dims[d], 1) \
+                    if dims[d] is not None else raw.shape[d]
+                if dims[d] is None and local % dp == 0:
+                    dims[d] = self.batch_axis
+                    return P(*dims)
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "ZeRO-%d: opt state for %r (shape %s, tp spec %s) has "
+                "no free dp-divisible dim; this moment stays on the "
+                "param sharding (replicated over dp)", self.zero_stage,
+                name, tuple(raw.shape), pspec)
+            return pspec
         if (dp and raw.ndim >= 1 and raw.shape[0] % dp == 0
                 and not (len(pspec) > 0 and pspec[0] is not None)):
             return P(self.batch_axis, *([None] * (raw.ndim - 1)))
@@ -732,6 +799,14 @@ class SPMDTrainStep:
         diff_idx = self._diff_idx()
         diff_set = set(diff_idx)
         rule_update = self._rule_update
+        if self._optimizer_name == "lamb" and stage >= 2:
+            # flat-sharded update: swap in the trust-ratio rule that
+            # reduces its norms over the data axis (the decline to
+            # stage 1 this used to force is gone)
+            ri, ru = _lamb_rule_sharded(self._hyper, axis)
+            if self._multi_precision:
+                ri, ru = mp_rule(ri, ru)
+            rule_update = ru
         run_forward = self._make_run_forward()
         plan = self._bucket_plan
         comp = self._compress_thr

@@ -369,6 +369,54 @@ def test_doctor_ranks_unhealthy_first():
     assert report["top"]["verdict"] == "input_bound"
 
 
+def _pipeline_event(schedule="gpipe", bubble=0.3, ticks=22, stash=8):
+    """One ``pipeline.schedule`` instant as record_pipeline_schedule
+    emits it at step-build time."""
+    return {"name": "pipeline.schedule", "cat": "parallel", "ph": "i",
+            "ts": 0.0, "pid": 1, "tid": 1,
+            "args": {"schedule": schedule, "bubble_fraction": bubble,
+                     "ticks": ticks, "stash_slots": stash}}
+
+
+def test_doctor_pipeline_bubble_bound_verdict():
+    """A fat measured bubble joined with compute-dominated phase spans
+    yields pipeline_bubble_bound: the host books schedule idle as
+    device compute, so the roofline verdict alone would mislead."""
+    events = [_phase_event(compute=9.0, host_gap=1.0, step=i)
+              for i in range(4)]
+    events += [_pipeline_event(schedule="gpipe", bubble=0.273)]
+    report = doctor.diagnose(events)
+    assert report["pipeline"], report
+    v = report["pipeline"][0]
+    assert v["verdict"] == "pipeline_bubble_bound"
+    assert v["schedule"] == "gpipe"
+    assert abs(v["bubble_fraction"] - 0.273) < 1e-9
+    # the join: evidence names the compute-dominated site's share
+    assert any("compute-bound" in e for e in v["evidence"]), v["evidence"]
+    assert "MXTPU_PIPELINE" in v["recipe"]
+    # phase-bound verdicts outrank it; with only compute-flops sites
+    # in the trace, the bubble is the actionable top verdict
+    assert report["top"]["verdict"] == "pipeline_bubble_bound"
+    rendered = doctor.render(report)
+    assert "pipeline_bubble_bound" in rendered
+
+
+def test_doctor_pipeline_bubble_below_threshold_silent():
+    """A tuned interleaved schedule (bubble under the bound) emits no
+    pipeline verdict, and an input-bound site still wins top."""
+    events = [_phase_event(input_wait=8.0, compute=2.0, step=i)
+              for i in range(4)]
+    events += [_pipeline_event(schedule="interleaved", bubble=0.059,
+                               ticks=34, stash=4)]
+    report = doctor.diagnose(events)
+    assert report["pipeline"] == []
+    assert report["top"]["verdict"] == "input_bound"
+    # over threshold but a starved input pipeline still outranks it
+    report2 = doctor.diagnose(events + [_pipeline_event(bubble=0.4)])
+    assert report2["pipeline"]
+    assert report2["top"]["verdict"] == "input_bound"
+
+
 def test_doctor_cli_seeded_scenarios(tmp_path):
     """The acceptance pair, end-to-end through the REAL plumbing: an
     input-starved loop and a staged-comm loop, recorded by attribution

@@ -1,0 +1,335 @@
+"""The composed 4D-parallel trainer (PR19): one mesh contract
+(dp, pp, tp, sp, ep), 1F1B-family pipeline schedules, Megatron-style
+tensor parallelism, and ZeRO sharding on the dp axis — every layout
+must reproduce the single-device autodiff loss trajectory, and the
+(dp, pp) -> (dp', pp') snapshot crossing must be bit-exact (the
+bit-exact pin itself lives in test_elastic.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu import parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.composed import Composed4DStep, tp_all_gather, tp_copy
+from mxnet_tpu.parallel.mesh import composed_mesh
+from mxnet_tpu.parallel.pipeline import (PipelineTrainStep,
+                                         build_pipeline_schedule,
+                                         stage_permutation)
+
+L, D, B, M = 4, 8, 16, 4
+
+_rng = np.random.RandomState(0)
+W0 = (_rng.randn(L, D, D) * 0.3).astype(np.float32)
+b0 = (_rng.randn(L, D) * 0.1).astype(np.float32)
+X = _rng.randn(B, D).astype(np.float32)
+Y = _rng.randn(B, D).astype(np.float32)
+
+
+def _stage_fn(p, h):
+    W, b = p
+    return jnp.tanh(h @ W + b)
+
+
+def _stage_fn_tp(p, h):
+    # W column-sharded over tp: the Megatron f/g bracket (identity
+    # fwd / psum bwd on entry, gather fwd / slice bwd on exit)
+    W, b = p
+    out = tp_copy(h, "tp") @ W
+    return jnp.tanh(tp_all_gather(out, "tp", axis=1) + b)
+
+
+def _loss_fn(o, y):
+    return jnp.mean((o - y) ** 2)
+
+
+def _ref_losses(steps=5, lr=0.1):
+    """Single-device plain-autodiff sgd reference trajectory."""
+    W, b = jnp.asarray(W0), jnp.asarray(b0)
+
+    @jax.jit
+    def one(W, b, x, y):
+        def loss_of(W, b):
+            h = x
+            for i in range(L):
+                h = _stage_fn((W[i], b[i]), h)
+            return _loss_fn(h, y)
+
+        loss, (gW, gb) = jax.value_and_grad(loss_of, (0, 1))(W, b)
+        return W - lr * gW, b - lr * gb, loss
+
+    out = []
+    for _ in range(steps):
+        W, b, l = one(W, b, jnp.asarray(X), jnp.asarray(Y))
+        out.append(float(l))
+    return out
+
+
+def _composed(mesh, zero, opt="sgd", tp_specs=None, sf=_stage_fn,
+              steps=5, lr=0.1, schedule=None):
+    step = Composed4DStep(sf, (jnp.asarray(W0), jnp.asarray(b0)), mesh,
+                          _loss_fn, optimizer=opt, num_microbatches=M,
+                          zero_stage=zero, tp_specs=tp_specs,
+                          schedule=schedule)
+    return step, [float(step(X, Y, lr=lr)) for _ in range(steps)]
+
+
+def _mesh_dp():
+    return composed_mesh(dp=4, devices=jax.devices()[:4])
+
+
+def _mesh_pp():
+    return composed_mesh(dp=2, pp=2, devices=jax.devices()[:4])
+
+
+def _mesh_3d():
+    return composed_mesh(dp=2, pp=2, tp=2)
+
+
+# ---------------------------------------------------------------------------
+# parity against the single-device trajectory
+# ---------------------------------------------------------------------------
+
+
+def test_composed_dp_only_matches_ref():
+    ref = _ref_losses()
+    step, ls = _composed(_mesh_dp(), 0)
+    assert step.schedule.name == "interleaved"  # pp=1 -> v=L chunks
+    np.testing.assert_allclose(ls, ref, atol=2e-5)
+
+
+@pytest.mark.parametrize("zero", [0, 2, 3])
+def test_composed_dp_pp_matches_ref(zero):
+    ref = _ref_losses()
+    _, ls = _composed(_mesh_pp(), zero)
+    np.testing.assert_allclose(ls, ref, atol=2e-5)
+
+
+@pytest.mark.parametrize("zero", [0, 2])
+def test_composed_dp_pp_tp_matches_ref(zero):
+    ref = _ref_losses()
+    _, ls = _composed(_mesh_3d(), zero, sf=_stage_fn_tp,
+                      tp_specs=(P(None, "tp"), P()))
+    np.testing.assert_allclose(ls, ref, atol=2e-5)
+
+
+def test_composed_gpipe_and_1f1b_match_ref():
+    ref = _ref_losses()
+    mesh = composed_mesh(dp=2, pp=4)
+    for sched in ("gpipe", "1f1b"):
+        step, ls = _composed(mesh, 0, schedule=sched)
+        assert step.schedule.name == sched
+        np.testing.assert_allclose(ls, ref, atol=2e-5, err_msg=sched)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("opt", ["adam", "lamb"])
+def test_composed_zero_stages_agree(opt):
+    """ZeRO is a memory layout, not a numeric change: stage 0/2/3 give
+    the SAME trajectory (lamb exercises the sharded trust-ratio norms
+    — psum over pp+dp must reproduce the unsharded global norm)."""
+    _, l0 = _composed(_mesh_pp(), 0, opt=opt, lr=0.02)
+    _, l2 = _composed(_mesh_pp(), 2, opt=opt, lr=0.02)
+    _, l3 = _composed(_mesh_pp(), 3, opt=opt, lr=0.02)
+    np.testing.assert_allclose(l2, l0, atol=2e-5)
+    np.testing.assert_allclose(l3, l0, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_composed_lamb_tp_sharded_norms_agree():
+    """lamb + tensor-parallel leaves: the trust-ratio norm must span
+    the tp shards too (per-leaf psum axes), so zero-0 and zero-2 agree
+    on a (dp, pp, tp) mesh."""
+    _, l0 = _composed(_mesh_3d(), 0, opt="lamb", lr=0.02,
+                      sf=_stage_fn_tp, tp_specs=(P(None, "tp"), P()))
+    _, l2 = _composed(_mesh_3d(), 2, opt="lamb", lr=0.02,
+                      sf=_stage_fn_tp, tp_specs=(P(None, "tp"), P()))
+    np.testing.assert_allclose(l2, l0, atol=2e-5)
+
+
+@pytest.mark.slow
+def test_composed_superstep_matches_stepwise():
+    stepA, ls = _composed(_mesh_pp(), 2, opt="adam", lr=0.02, steps=4)
+    stepB, _ = _composed(_mesh_pp(), 2, opt="adam", lr=0.02, steps=0)
+    xs = np.stack([X] * 4)
+    ys = np.stack([Y] * 4)
+    got = [float(v) for v in stepB.run_superstep(xs, ys, lr=0.02)]
+    np.testing.assert_allclose(got, ls, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# memory layout + reports
+# ---------------------------------------------------------------------------
+
+
+def test_composed_zero2_shards_optimizer_memory():
+    s0, _ = _composed(_mesh_pp(), 0, opt="adam", steps=1, lr=0.02)
+    s2, _ = _composed(_mesh_pp(), 2, opt="adam", steps=1, lr=0.02)
+    m0, m2 = s0.memory_report(), s2.memory_report()
+    # dp=2: ZeRO-2 halves per-device optimizer state (within padding)
+    assert m2["opt_bytes_per_device"] <= m0["opt_bytes_per_device"] \
+        * 0.55, (m0, m2)
+    assert m2["zero_stage"] == 2 and m0["zero_stage"] == 0
+    for key in ("schedule", "bubble_fraction", "stash_slots",
+                "param_bytes_per_device"):
+        assert key in m0, m0
+
+
+def test_composed_schedule_report_fields():
+    step, _ = _composed(_mesh_pp(), 0, steps=0)
+    rep = step.schedule_report()
+    assert rep["schedule"] == "interleaved"  # L=4 over pp=2 -> v=2
+    assert rep["ranks"] == 2 and rep["virtual"] == 2
+    assert 0.0 <= rep["bubble_fraction"] < 1.0
+    assert rep["stash_slots"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# schedule table pins (host-side, no compile)
+# ---------------------------------------------------------------------------
+
+
+def test_bubble_fraction_table():
+    """The honest schedule math, pinned: plain 1F1B keeps GPipe's
+    fill-drain bubble (S-1)/(M+S-1) and only shrinks the activation
+    stash to S in-flight microbatches; interleaving v chunks cuts the
+    bubble to (S-1)/(M*v+S-1)."""
+    gp = build_pipeline_schedule(4, 8, "gpipe")
+    f1b = build_pipeline_schedule(4, 8, "1f1b")
+    il = build_pipeline_schedule(2, 8, "interleaved", virtual=2)
+    assert abs(gp.bubble_fraction - 3.0 / 11.0) < 1e-6
+    assert abs(f1b.bubble_fraction - gp.bubble_fraction) < 1e-9
+    assert f1b.stash_slots == 4 and gp.stash_slots == 8
+    assert abs(il.bubble_fraction - 1.0 / 17.0) < 1e-6
+    assert 1.0 - il.bubble_fraction >= 0.9  # the PR19 overlap gate
+    gp2 = build_pipeline_schedule(2, 8, "gpipe")
+    assert il.bubble_fraction < gp2.bubble_fraction
+
+
+def test_stage_permutation_roundtrip():
+    for S, v in [(2, 2), (4, 2), (2, 4), (3, 3)]:
+        perm = stage_permutation(S, v)
+        assert sorted(perm) == list(range(S * v))
+        # position p = r*v + c holds global stage c*S + r
+        for r in range(S):
+            for c in range(v):
+                assert perm[r * v + c] == c * S + r
+        inv = np.argsort(np.asarray(perm))
+        np.testing.assert_array_equal(
+            np.asarray(perm)[inv], np.arange(S * v))
+
+
+# ---------------------------------------------------------------------------
+# contract errors
+# ---------------------------------------------------------------------------
+
+
+def test_composed_declines_sp_ep_axes():
+    four = jax.devices()[:4]
+    mesh = composed_mesh(dp=2, sp=2, devices=four)
+    with pytest.raises(MXNetError, match="ring_attention"):
+        Composed4DStep(_stage_fn, (jnp.asarray(W0), jnp.asarray(b0)),
+                       mesh, _loss_fn)
+    mesh = composed_mesh(dp=2, ep=2, devices=four)
+    with pytest.raises(MXNetError, match="moe_apply_a2a"):
+        Composed4DStep(_stage_fn, (jnp.asarray(W0), jnp.asarray(b0)),
+                       mesh, _loss_fn)
+
+
+def test_composed_gpipe_needs_one_stage_per_rank():
+    # L=4 stages over pp=2 means v=2 virtual chunks: fill-drain and
+    # plain 1F1B must decline loudly toward interleaved
+    for sched in ("gpipe", "1f1b"):
+        with pytest.raises(MXNetError, match="interleaved"):
+            _composed(_mesh_pp(), 0, schedule=sched, steps=0)
+
+
+def test_composed_batch_must_tile_dp():
+    step, _ = _composed(_mesh_pp(), 0, steps=0)
+    bad = np.zeros((6, D), np.float32)  # 6/M microbatch can't tile dp=2
+    with pytest.raises(MXNetError, match="dp"):
+        step(bad, bad[:, :D], lr=0.1)
+
+
+def test_spmd_step_declines_pp_mesh():
+    from mxnet_tpu.parallel.spmd import SPMDTrainStep
+
+    net = None  # params unused: the mesh contract fails first
+    with pytest.raises(MXNetError, match="Composed4DStep"):
+        SPMDTrainStep(net, _loss_fn, mesh=_mesh_pp())
+
+
+# ---------------------------------------------------------------------------
+# pipeline schedule trajectory parity through PipelineTrainStep
+# (sgd/adam x AMP off/bf16): 1F1B and interleaved are reorderings of
+# the same microbatch work — the update must be identical to gpipe's
+# ---------------------------------------------------------------------------
+
+
+def _pp_stages(n):
+    rng = np.random.RandomState(7)
+    return [(jnp.asarray((np.eye(D) + rng.randn(D, D) * 0.05)
+                         .astype(np.float32)),
+             jnp.asarray(np.full(D, 0.05, np.float32)))
+            for _ in range(n)]
+
+
+def _pp_losses(schedule, stages, S, opt, amp, steps=3):
+    from mxnet_tpu.parallel.pipeline import stack_stage_params
+
+    mesh = parallel.make_mesh({"pp": S}, devices=jax.devices()[:S])
+    step = PipelineTrainStep(
+        _stage_fn, stack_stage_params(stages), mesh, _loss_fn,
+        num_microbatches=4, schedule=schedule, optimizer=opt,
+        amp_dtype=amp)
+    x = np.asarray(X[:8], np.float32)
+    y = np.asarray(Y[:8], np.float32)
+    return [float(step(x, y, lr=0.05)) for _ in range(steps)]
+
+
+# one (opt, amp) cell stays in tier-1 as the representative; the rest
+# of the matrix compiles 9 extra pipeline graphs (~25 s) for the same
+# schedule-equivalence property and runs with the slow tier
+@pytest.mark.parametrize("opt,amp", [
+    ("sgd", None),
+    pytest.param("adam", None, marks=pytest.mark.slow),
+    pytest.param("sgd", "bfloat16", marks=pytest.mark.slow),
+    pytest.param("adam", "bfloat16", marks=pytest.mark.slow),
+])
+def test_pipeline_schedules_agree(opt, amp):
+    stages = _pp_stages(2)
+    gp = _pp_losses("gpipe", stages, 2, opt, amp)
+    f1b = _pp_losses("1f1b", stages, 2, opt, amp)
+    il = _pp_losses("interleaved", _pp_stages(4), 2, opt, amp)
+    tol = 2e-2 if amp else 2e-5
+    np.testing.assert_allclose(f1b, gp, atol=tol)
+    # interleaved runs 4 stages as 2 virtual chunks per rank — a
+    # different (deeper) net, so only the gpipe/1f1b pair is exact;
+    # the interleaved leg must still train sanely
+    assert il[-1] <= il[0] + tol, il
+    if amp is None and opt == "sgd":
+        # AMP off: the manual tick-table executor reproduces plain
+        # autodiff exactly
+        W = np.stack([np.asarray(w) for w, _ in stages])
+        bb = np.stack([np.asarray(b) for _, b in stages])
+
+        def ref():
+            Wj, bj = jnp.asarray(W), jnp.asarray(bb)
+            out = []
+            for _ in range(3):
+                def loss_of(Wj, bj):
+                    h = jnp.asarray(X[:8])
+                    for i in range(2):
+                        h = _stage_fn((Wj[i], bj[i]), h)
+                    return _loss_fn(h, jnp.asarray(Y[:8]))
+
+                loss, (gW, gb) = jax.value_and_grad(
+                    loss_of, (0, 1))(Wj, bj)
+                Wj, bj = Wj - 0.05 * gW, bj - 0.05 * gb
+                out.append(float(loss))
+            return out
+
+        np.testing.assert_allclose(gp, ref(), atol=2e-5)

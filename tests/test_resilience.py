@@ -438,7 +438,9 @@ def _parse(res):
 
 
 @pytest.mark.parametrize("superstep,fp16,opt", [
-    (0, True, "adam"),   # fused one-step loop, fp16 AMP + masters
+    # fused one-step loop, fp16 AMP + masters: same kill/resume drill
+    # through a second subprocess pair (~12 s) — slow tier keeps it
+    pytest.param(0, True, "adam", marks=pytest.mark.slow),
     (3, False, "sgd"),   # K-step superstep capture
 ], ids=["fused_adam_fp16", "superstep_sgd"])
 def test_kill_and_resume_subprocess(tmp_path, superstep, fp16, opt):

@@ -57,6 +57,11 @@ _LOWER_BETTER = re.compile(
     r"(_ms|_s$|_us|seconds|latency|overhead|_time|time_|p50|p99|p999"
     r"|lost|miss|stale|errors|skew|wait|age|exposed|dispatch)", re.I)
 
+#: checked before the generic token maps: ``bubble_fraction`` and MoE
+#: ``drop(ped)_fraction`` are lower-is-better even though the bare
+#: ``fraction`` segment (comm_hidden_fraction etc.) reads higher-better
+_LOWER_FIRST = re.compile(r"(bubble|drop(ped)?_fraction)", re.I)
+
 #: unit-based direction for emit rows (takes precedence over names)
 _UNIT_HIGHER = re.compile(r"/s$|/sec$", re.I)
 _UNIT_LOWER = re.compile(r"^(ms|s|us|sec|seconds)$", re.I)
@@ -69,6 +74,8 @@ def direction(name: str, unit: str = "") -> str:
             return "higher"
         if _UNIT_LOWER.match(unit):
             return "lower"
+    if _LOWER_FIRST.search(name):
+        return "lower"
     if _HIGHER_BETTER.search(name):
         return "higher"
     if _LOWER_BETTER.search(name):

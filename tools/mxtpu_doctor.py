@@ -73,6 +73,14 @@ RECIPES = {
         "requests spend their latency waiting for admission/batching",
         "raise max_batch / shrink max_wait on the ContinuousBatcher, "
         "add bucket capacity, or scale serving replicas"),
+    "pipeline_bubble_bound": (
+        "pipeline ranks idle in schedule fill/drain bubbles",
+        "raise microbatches per step (MXTPU_PIPELINE_MICROBATCHES) or "
+        "run the interleaved schedule (MXTPU_PIPELINE_SCHEDULE="
+        "interleaved, stages a multiple of the pp axis) — the bubble "
+        "shrinks as (S-1)/(M*v + S-1); plain 1f1b matches gpipe's "
+        "bubble and only cuts activation-stash memory "
+        "(docs/performance.md)"),
     "healthy": (
         "no phase dominates the step budget",
         "nothing to do — re-run with a longer window if this "
@@ -311,20 +319,86 @@ def anomaly_counts(events) -> dict:
     return out
 
 
+#: bubble threshold for the pipeline verdict — a tuned interleaved
+#: schedule sits well under this; fill-drain at few microbatches does not
+_BUBBLE_FRAC = 0.15
+
+
+def pipeline_schedule_records(events) -> list:
+    """The ``pipeline.schedule`` instants a pipeline step publishes at
+    build time (measured bubble per realized schedule)."""
+    out = []
+    for ev in events:
+        if ev.get("name") != "pipeline.schedule":
+            continue
+        args = ev.get("args") if isinstance(ev.get("args"), dict) else {}
+        bf = _num(args, "bubble_fraction")
+        if bf is None:
+            continue
+        out.append({"schedule": str(args.get("schedule", "-")),
+                    "bubble_fraction": bf,
+                    "ticks": args.get("ticks"),
+                    "stash_slots": args.get("stash_slots")})
+    return out
+
+
+def pipeline_verdicts(events) -> list:
+    """``pipeline_bubble_bound``: the schedule gauge says ranks idle in
+    fill/drain, joined against the phase spans — host-side attribution
+    books that idle as device compute, so a compute-dominated site with
+    a fat bubble is really schedule-bound, not flops-bound."""
+    recs = pipeline_schedule_records(events)
+    if not recs:
+        return []
+    worst = max(recs, key=lambda r: r["bubble_fraction"])
+    if worst["bubble_fraction"] < _BUBBLE_FRAC:
+        return []
+    evidence = [
+        f"schedule {worst['schedule']}: bubble_fraction = "
+        f"{worst['bubble_fraction']:.3f} over {worst['ticks']} ticks "
+        f"(stash_slots = {worst['stash_slots']})"]
+    for site, ph in sorted(phase_summary(events).items()):
+        step = ph["step_s"]
+        if step > 0 and ph["compute"] / step >= 0.5:
+            evidence.append(
+                f"site {site} looks compute-bound from the host "
+                f"({ph['compute'] / step * 100:.1f}% of step) but "
+                f"{worst['bubble_fraction'] * 100:.0f}% of that device "
+                "time is pipeline fill/drain idle")
+    meaning, recipe = RECIPES["pipeline_bubble_bound"]
+    return [{"site": "pipeline", "verdict": "pipeline_bubble_bound",
+             "meaning": meaning, "recipe": recipe,
+             "schedule": worst["schedule"],
+             "bubble_fraction": round(worst["bubble_fraction"], 6),
+             "evidence": evidence}]
+
+
 def diagnose(events) -> dict:
     """The full machine-readable report for one trace."""
     training = training_verdicts(events)
     serving = serving_verdicts(events)
+    pipeline = pipeline_verdicts(events)
     report = {
         "format": "mxtpu-doctor-v1",
         "training": training,
         "serving": serving,
+        "pipeline": pipeline,
         "anomalies": anomaly_counts(events),
     }
-    ranked = [v for v in training if v["verdict"] != "healthy"] or training
-    if ranked:
+    ranked = [v for v in training if v["verdict"] != "healthy"]
+    # a fat bubble explains a compute-bound site (the idle is booked as
+    # device compute), so it outranks the roofline verdicts — but not
+    # input/comm/host starvation, which the schedule can't cause
+    if pipeline and (not ranked
+                     or ranked[0]["verdict"].startswith("compute_")):
+        report["top"] = {"site": "pipeline",
+                         "verdict": pipeline[0]["verdict"]}
+    elif ranked:
         report["top"] = {"site": ranked[0]["site"],
                          "verdict": ranked[0]["verdict"]}
+    elif training:
+        report["top"] = {"site": training[0]["site"],
+                         "verdict": training[0]["verdict"]}
     elif serving:
         report["top"] = {"site": f"serving:{serving[0]['model']}",
                          "verdict": serving[0]["verdict"]}
@@ -339,6 +413,14 @@ def render(report) -> str:
                      f"{v['meaning']}")
         lines.append(f"    {v['steps']} steps @ {v['step_ms']:.3f} "
                      f"ms/step")
+        for e in v["evidence"]:
+            lines.append(f"    evidence: {e}")
+        lines.append(f"    recipe: {v['recipe']}")
+    for v in report.get("pipeline", []):
+        lines.append(f"\n  [pipeline] verdict: {v['verdict']} — "
+                     f"{v['meaning']}")
+        lines.append(f"    schedule {v['schedule']}, bubble_fraction "
+                     f"{v['bubble_fraction']:.3f}")
         for e in v["evidence"]:
             lines.append(f"    evidence: {e}")
         lines.append(f"    recipe: {v['recipe']}")

@@ -159,6 +159,35 @@ def collect_records(steps=2):
         introspect.reset()
         one(run_super=False)
 
+    def leg_composed4d():
+        # the composed (dp, pp) 4D step: pins the pipeline ppermute
+        # rings + dp psum/psum_scatter collective schedule
+        if len(jax.devices()) < 4:
+            return
+        import jax.numpy as jnp
+
+        from mxnet_tpu.parallel.composed import Composed4DStep
+        from mxnet_tpu.parallel.mesh import composed_mesh
+
+        rng = np.random.RandomState(0)
+        L, D = 2, 8
+        W0 = jnp.asarray((rng.randn(L, D, D) * 0.3).astype(np.float32))
+        b0 = jnp.asarray((rng.randn(L, D) * 0.1).astype(np.float32))
+        x = rng.randn(8, D).astype(np.float32)
+        y = rng.randn(8, D).astype(np.float32)
+
+        def stage_fn(p, h):
+            W, b = p
+            return jnp.tanh(h @ W + b)
+
+        def loss_of(o, yy):
+            return jnp.mean((o - yy) ** 2)
+
+        mesh = composed_mesh(dp=2, pp=2, devices=jax.devices()[:4])
+        step = Composed4DStep(stage_fn, (W0, b0), mesh, loss_of,
+                              num_microbatches=2, zero_stage=2)
+        step(x, y, lr=0.05)
+
     def leg_kvstore():
         devs = jax.devices()[:2]
         if len(devs) < 2:
@@ -228,7 +257,8 @@ def collect_records(steps=2):
     introspect.reset()
     try:
         for leg in (leg_amp, leg_plain, leg_superstep, leg_spmd,
-                    leg_kvstore, leg_serving, leg_decode):
+                    leg_composed4d, leg_kvstore, leg_serving,
+                    leg_decode):
             introspect.reset()
             leg()
     finally:

@@ -51,6 +51,11 @@ HOT_FUNCTIONS = [
     # SPMD mesh-side step
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.step"),
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.run_superstep"),
+    # composed 4D step: the per-step entry points and the host-side
+    # dispatch wrappers around the compiled pipeline schedule
+    ("mxnet_tpu/parallel/composed.py", "Composed4DStep.__call__"),
+    ("mxnet_tpu/parallel/composed.py", "Composed4DStep.run_superstep"),
+    ("mxnet_tpu/parallel/pipeline.py", "PipelineTrainStep.__call__"),
     # serving: the continuous-batching scheduler loop and the per-batch
     # execute hook (submit->result latency IS the SLO — a stray sync
     # here serializes every request behind it)
