@@ -404,6 +404,53 @@ int MXTPURecordIOSeek(RecordIOHandle h, uint64_t pos) {
   return fseeko(f->fp, pos, SEEK_SET);
 }
 
+int64_t MXTPURecordIOScanIndex(const char* path, uint64_t* offsets,
+                               int64_t capacity) {
+  FILE* fp = fopen(path, "rb");
+  if (!fp) {
+    SetError(std::string("cannot open ") + path);
+    return -1;
+  }
+  int64_t count = 0;
+  while (true) {
+    uint64_t pos = static_cast<uint64_t>(ftello(fp));
+    uint32_t hdr[2];
+    size_t n = fread(hdr, 4, 2, fp);
+    if (n == 0) break;  // clean EOF
+    if (n != 2 || hdr[0] != kMagic) {
+      SetError("invalid RecordIO magic during index scan");
+      fclose(fp);
+      return -1;
+    }
+    uint64_t len = hdr[1] & kLenMask;
+    uint64_t padded = len + ((4 - (len % 4)) % 4);
+    if (fseeko(fp, padded, SEEK_CUR) != 0) {
+      SetError("truncated record during index scan");
+      fclose(fp);
+      return -1;
+    }
+    if (offsets != nullptr && count < capacity) offsets[count] = pos;
+    ++count;
+  }
+  fclose(fp);
+  return count;
+}
+
+int64_t MXTPURecordIOReadAt(RecordIOHandle h, uint64_t offset,
+                            const uint8_t** data) {
+  auto* f = static_cast<RecordIOFile*>(h);
+  if (fseeko(f->fp, offset, SEEK_SET) != 0) {
+    SetError("seek failed");
+    return -1;
+  }
+  int64_t n = MXTPURecordIOReadRecord(h, data);
+  if (n == 0) {
+    SetError("indexed read at EOF offset");
+    return -1;
+  }
+  return n;
+}
+
 int64_t MXTPURecordIOTell(RecordIOHandle h) {
   auto* f = static_cast<RecordIOFile*>(h);
   return ftello(f->fp);

@@ -35,6 +35,22 @@ int MXTPURecordIOWriteRecord(RecordIOHandle h, const uint8_t* data,
 int MXTPURecordIOSeek(RecordIOHandle h, uint64_t pos);
 int64_t MXTPURecordIOTell(RecordIOHandle h);
 
+// One sequential scan of a RecordIO pack collecting the byte offset of
+// every record header (the O(1)-per-record shard index the streaming
+// reader builds when no .idx sidecar exists). Returns the total record
+// count, or -1 on a bad magic / truncated header. When `offsets` is
+// non-null, up to `capacity` offsets are filled (call once with
+// offsets=nullptr to size the buffer, then again to fill it — the scan
+// is pure fseeko hops over the payloads, no record bytes are read).
+int64_t MXTPURecordIOScanIndex(const char* path, uint64_t* offsets,
+                               int64_t capacity);
+
+// Indexed random-access read: seek to a known record offset and read
+// that one record. Returns the payload length, or -1 on error; the
+// data pointer is valid until the next read on this handle.
+int64_t MXTPURecordIOReadAt(RecordIOHandle h, uint64_t offset,
+                            const uint8_t** data);
+
 // ---------------- image decode ----------------
 // Decodes JPEG or PNG from memory. Returns 0 on success.
 // On success *w/*h/*c are filled; caller buffer `out` must hold w*h*c bytes

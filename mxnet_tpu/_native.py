@@ -49,6 +49,17 @@ def get_lib():
         lib.MXTPURecordIOReadRecord.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
         lib.MXTPURecordIOTell.restype = ctypes.c_int64
+        if hasattr(lib, "MXTPURecordIOScanIndex"):
+            # streaming-shard index fast path (absent in a stale .so:
+            # callers fall back to the pure-Python scan)
+            lib.MXTPURecordIOScanIndex.restype = ctypes.c_int64
+            lib.MXTPURecordIOScanIndex.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_int64]
+            lib.MXTPURecordIOReadAt.restype = ctypes.c_int64
+            lib.MXTPURecordIOReadAt.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
         lib.MXTPUPipelineCreate.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,

@@ -17,4 +17,7 @@ from .dataloader import DataLoader  # noqa: F401
 from .prefetcher import (DevicePrefetcher, SuperstepRing,  # noqa: F401
                          prefetch_depth, stack_batches)  # noqa: F401
 from .shape_guard import SequenceBucketer, pad_batch  # noqa: F401
+from .stream import (GlobalOrder, ShardIndex, ShardSet,  # noqa: F401
+                     StreamReader, device_augment,  # noqa: F401
+                     write_recordio_shards)  # noqa: F401
 from . import vision  # noqa: F401

@@ -91,6 +91,10 @@ class DevicePrefetcher:
         self._stop = threading.Event()
         self._exhausted = False
         self._delivered = 0  # batches handed to the consumer this epoch
+        self._source_steps0 = 0  # stream-source step count at epoch start:
+        # a structured cursor reports steps0 + delivered, so batches the
+        # producer staged ahead but never handed over are NOT marked
+        # consumed (they re-read on resume — zero skip)
         self._placement_gen = 0  # bumped by repartition(): staged-ahead
         # batches carry the generation they were placed under, and a
         # stale one is re-staged onto the CURRENT mesh at delivery
@@ -189,6 +193,9 @@ class DevicePrefetcher:
             self._source.reset()
         self._exhausted = False
         self._delivered = 0
+        state_fn = getattr(self._source, "state", None)
+        if callable(state_fn):
+            self._source_steps0 = int(state_fn().get("steps", 0))
         with self._lifecycle_lock:
             self._stop = threading.Event()
             self._queue = queue.Queue(maxsize=self._depth)
@@ -245,13 +252,23 @@ class DevicePrefetcher:
     def next(self):
         return self.__next__()
 
-    def repartition(self, mesh=None, device=None, batch_axis=None):
+    def repartition(self, mesh=None, device=None, batch_axis=None,
+                    world=None, rank=None):
         """Re-partition the pipeline across a NEW device extent WITHOUT
         losing position (the elastic-resize hook): the deterministic
         ``cursor`` is untouched, batches already staged ahead on the
         old mesh are re-staged onto the new one at delivery, and
         everything staged from here on lands on the new extent
-        directly — a dp change never skips or replays data."""
+        directly — a dp change never skips or replays data.
+
+        ``world``/``rank`` additionally re-partition a streaming
+        SOURCE (one exposing ``repartition(world, rank, steps=)``, e.g.
+        :class:`~.stream.StreamReader`) across a new rank extent: the
+        in-flight epoch stops, the source's global cursor rebases to
+        the last DELIVERED batch (staged-ahead batches were never
+        marked consumed, so they re-read under the new partitioning —
+        zero skip, zero replay), and the next ``next()`` resumes
+        there."""
         if mesh is not None and device is not None:
             raise ValueError("pass device OR mesh, not both")
         if batch_axis is not None:
@@ -261,13 +278,34 @@ class DevicePrefetcher:
         elif device is not None:
             self._device, self._mesh = device, None
         self._placement_gen += 1
+        if world is not None or rank is not None:
+            rp = getattr(self._source, "repartition", None)
+            if not callable(rp):
+                raise ValueError(
+                    "repartition(world=, rank=): source "
+                    f"{type(self._source).__name__} has no repartition() "
+                    "— only streaming sources re-shard their cursor")
+            steps = self._source_steps0 + self._delivered
+            self.close()  # join producer before rewinding its source
+            rp(world=world, rank=rank, steps=steps)
+            self._exhausted = False
+            self._delivered = 0
+            self._source_steps0 = 0  # rebased: steps reset with base
         return self
 
     @property
     def cursor(self):
-        """Batches DELIVERED to the consumer this epoch — the
-        input-pipeline position a checkpoint records so a resumed epoch
-        can ``resilience.resume.skip_batches`` past consumed data."""
+        """The input-pipeline position a checkpoint records. A
+        streaming source (one exposing ``state()``) yields its
+        structured global cursor, adjusted to batches DELIVERED to the
+        consumer (staged-ahead work is not consumed); otherwise the
+        plain delivered-batch count this epoch, for
+        ``resilience.resume.skip_batches``."""
+        state_fn = getattr(self._source, "state", None)
+        if callable(state_fn):
+            if self._thread is None and not self._exhausted:
+                return state_fn()  # no epoch in flight: source is truth
+            return state_fn(steps=self._source_steps0 + self._delivered)
         return self._delivered
 
     def __len__(self):
@@ -464,12 +502,15 @@ class SuperstepRing:
         manager as the data-pipeline position."""
         return self._pf.cursor
 
-    def repartition(self, mesh=None, device=None, batch_axis=None):
+    def repartition(self, mesh=None, device=None, batch_axis=None,
+                    world=None, rank=None):
         """Delegate to the underlying prefetcher (elastic resize: the
         cursor is preserved; staged batches re-stage onto the new
-        extent at delivery)."""
+        extent at delivery; ``world``/``rank`` re-shard a streaming
+        source's global cursor)."""
         self._pf.repartition(mesh=mesh, device=device,
-                             batch_axis=batch_axis)
+                             batch_axis=batch_axis, world=world,
+                             rank=rank)
         return self
 
     def reset(self):

@@ -202,6 +202,44 @@ DATA_PREFETCH_WAIT_DELTA = _REGISTRY.gauge(
     "plane) — an input-wait spike is visible here where the running "
     "total hides it; the watchdog's input_wait detector reads this")
 
+# -- streaming data plane (gluon/data/stream.py) -------------------------
+STREAM_READ_BYTES = _REGISTRY.counter(
+    "mxtpu_stream_read_bytes_total",
+    "raw bytes read from storage by the streaming shard reader, by "
+    "shard (divide by _seconds for the per-shard read rate)")
+STREAM_READ_SECONDS = _REGISTRY.counter(
+    "mxtpu_stream_read_seconds_total",
+    "wall time the read-ahead thread spent in storage reads, by shard "
+    "(includes emulated MXTPU_STREAM_LATENCY_MS slow-storage latency)")
+STREAM_RECORDS_TOTAL = _REGISTRY.counter(
+    "mxtpu_stream_records_total",
+    "records fetched from shards by the streaming reader, by shard")
+STREAM_DECODE_SECONDS = _REGISTRY.counter(
+    "mxtpu_stream_decode_seconds_total",
+    "wall time the decode pool spent decoding records (busy time; "
+    "utilization = busy / (busy + wait))")
+STREAM_DECODE_WAIT_SECONDS = _REGISTRY.counter(
+    "mxtpu_stream_decode_wait_seconds_total",
+    "wall time decode-pool workers spent idle waiting on the raw-record "
+    "queue — high means storage (not decode) is the bottleneck")
+STREAM_CONSUMER_WAIT_SECONDS = _REGISTRY.counter(
+    "mxtpu_stream_consumer_wait_seconds_total",
+    "train-thread wall time blocked waiting on the streaming reader "
+    "for a full batch — the 'input-bound' signal; ≈0 when the decode "
+    "pool keeps up with the superstep")
+STREAM_QUEUE_DEPTH = _REGISTRY.gauge(
+    "mxtpu_stream_queue_depth",
+    "streaming-reader staging depth, by queue (raw = undecoded "
+    "records awaiting the decode pool; reorder = decoded samples "
+    "awaiting in-order consumption)")
+STREAM_BATCHES_TOTAL = _REGISTRY.counter(
+    "mxtpu_stream_batches_total",
+    "batches delivered in deterministic global order by StreamReader")
+STREAM_REPARTITIONS_TOTAL = _REGISTRY.counter(
+    "mxtpu_stream_repartitions_total",
+    "elastic re-partitions of the streaming cursor (resize events "
+    "rebasing base_batch so no sample is skipped or replayed)")
+
 COMPILE_CACHE_HITS = _REGISTRY.counter(
     "mxtpu_compile_cache_hit_total",
     "XLA executables served from the persistent compilation cache "
@@ -811,6 +849,53 @@ def record_h2d(nbytes: int, dt: float, depth: int):
     DATA_PREFETCH_QUEUE_DEPTH.set(depth)
     _TRACER.record("data.h2d", cat="io", ts=_time.perf_counter() - dt,
                    dur=dt, args={"bytes": nbytes, "queue_depth": depth})
+
+
+def record_stream_read(shard: str, nbytes: int, dt: float):
+    """One storage read op by the streaming shard reader
+    (gluon/data/stream.py ShardIndex.read)."""
+    STREAM_READ_BYTES.inc(nbytes, shard=shard)
+    STREAM_READ_SECONDS.inc(dt, shard=shard)
+    STREAM_RECORDS_TOTAL.inc(1, shard=shard)
+
+
+def record_stream_decode(dt: float):
+    """One record decoded by the stream decode pool (busy time)."""
+    STREAM_DECODE_SECONDS.inc(dt)
+
+
+def record_stream_batch(wait: float, reorder_depth: int):
+    """One batch delivered by StreamReader: consumer-wait accounting
+    + the per-batch trace span telemetry_report joins against steps.
+    Every 16th batch also emits a ``stream.stats`` instant carrying
+    the cumulative per-shard read totals and decode-pool busy/wait so
+    an exported trace is self-contained for the Input-pipeline
+    section (registry counters don't travel with the JSONL)."""
+    STREAM_BATCHES_TOTAL.inc()
+    STREAM_CONSUMER_WAIT_SECONDS.inc(wait)
+    STREAM_QUEUE_DEPTH.set(reorder_depth, queue="reorder")
+    _TRACER.record("stream.batch", cat="io",
+                   ts=_time.perf_counter() - wait, dur=wait,
+                   args={"consumer_wait": wait,
+                         "reorder_depth": reorder_depth})
+    n = STREAM_BATCHES_TOTAL.total()
+    if n % 16 == 1:
+        per_shard = {}
+        for labels in STREAM_READ_BYTES.labelsets():
+            shard = labels.get("shard", "-")
+            per_shard[shard] = {
+                "bytes": STREAM_READ_BYTES.value(**labels),
+                "seconds": STREAM_READ_SECONDS.value(**labels),
+                "records": STREAM_RECORDS_TOTAL.value(**labels)}
+        _TRACER.record(
+            "stream.stats", cat="io", ph="i",
+            args={"per_shard": per_shard,
+                  "decode_busy": STREAM_DECODE_SECONDS.total(),
+                  "decode_wait": STREAM_DECODE_WAIT_SECONDS.total(),
+                  "consumer_wait": STREAM_CONSUMER_WAIT_SECONDS.total(),
+                  "depth_raw": STREAM_QUEUE_DEPTH.value(queue="raw"),
+                  "depth_reorder": reorder_depth,
+                  "batches": n})
 
 
 def record_ckpt_tick(dt: float):

@@ -198,7 +198,11 @@ def snapshot_trainer(trainer, net=None, step=None, cursor=None):
     if step is not None:
         extras["step"] = int(step)
     if cursor is not None:
-        extras["cursor"] = int(cursor)
+        # int = delivered-batch count (resume.skip_batches); dict = a
+        # structured streaming cursor (stream.StreamReader.state()) —
+        # JSON-serializable, rides the extras sidecar verbatim
+        extras["cursor"] = dict(cursor) if isinstance(cursor, dict) \
+            else int(cursor)
     # ONE dispatch: donation-safe copies of every leaf
     keys = sorted(tensors)
     copies = _copy_leaves([jnp.asarray(tensors[k]) for k in keys])
@@ -802,11 +806,11 @@ class CheckpointManager:
 
     def _cursor_value(self, cursor=None):
         if cursor is not None:
-            return int(cursor)
+            return cursor if isinstance(cursor, dict) else int(cursor)
         if self._ring is not None:
             c = getattr(self._ring, "cursor", None)
             if c is not None:
-                return int(c)
+                return c if isinstance(c, dict) else int(c)
         return getattr(self, "_cursor", None)
 
     # -- save paths ------------------------------------------------------

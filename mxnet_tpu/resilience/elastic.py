@@ -362,7 +362,9 @@ def snapshot_descriptor(chunks, extents=None, step=None, reason="resize",
     return {"format": DESCRIPTOR_FORMAT, "kind": "spmd-snapshot",
             "step": None if step is None else int(step),
             "reason": reason,
-            "cursor": None if cursor is None else int(cursor),
+            "cursor": (None if cursor is None else
+                       dict(cursor) if isinstance(cursor, dict) else
+                       int(cursor)),
             "topology": {"from_devices": from_devices,
                          "to_devices": to_devices},
             "residual_extents": {k: int(v)
@@ -583,7 +585,7 @@ class ElasticTrainer:
         if self._ring is not None:
             c = getattr(self._ring, "cursor", None)
             if c is not None:
-                return int(c)
+                return c if isinstance(c, dict) else int(c)
         return None
 
     def resize(self, new_devices, reason="manual"):

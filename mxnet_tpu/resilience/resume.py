@@ -354,6 +354,28 @@ def skip_batches(source, n):
     return it
 
 
+def restore_cursor(source, cursor):
+    """Re-position ``source`` at a checkpoint's data ``cursor``,
+    whatever its shape: a structured streaming cursor (a dict from
+    ``stream.StreamReader.state()``) restores natively via
+    ``source.restore()`` — O(1), bit-exact; an integer delivered-batch
+    count falls back to :func:`skip_batches`. Returns an iterator
+    positioned at the first unconsumed batch."""
+    if cursor is None:
+        return iter(source)
+    if isinstance(cursor, dict):
+        restore = getattr(source, "restore", None)
+        if callable(restore):
+            restore(cursor)
+            return iter(source)
+        raise MXNetError(
+            f"restore_cursor: checkpoint carries a structured "
+            f"{cursor.get('kind', '?')!r} cursor but source "
+            f"{type(source).__name__} has no restore() — rebuild the "
+            f"input pipeline as a StreamReader to resume it")
+    return skip_batches(source, int(cursor))
+
+
 def list_checkpoints(directory):
     """Committed ``(step, path)`` pairs under a checkpoint root."""
     return [(s, os.path.join(directory, _ckpt._step_dirname(s)))

@@ -142,6 +142,9 @@ def test_llama_tiny_train():
     assert losses[-1] < losses[0]
 
 
+# tp x dp mesh composition parity is pinned every tier-1 round by
+# test_composed4d.py; llama_tiny_train keeps the model itself tier-1
+@pytest.mark.slow
 def test_llama_tp_dp_mesh():
     mesh = parallel.make_mesh({"dp": 4, "tp": 2})
     net = models.llama_tiny()

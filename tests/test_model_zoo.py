@@ -24,6 +24,10 @@ def _smoke(name, input_size=224, classes=10, batch=1):
     return net
 
 
+# zoo construction stays tier-1 via resnet50_v1_shape / save-load
+# roundtrip; the train path through a zoo resnet runs every tier-1
+# round inside the bench smoke's resnet scenario
+@pytest.mark.slow
 def test_resnet18_v1_forward_backward():
     net = vision.get_model("resnet18_v1", classes=10)
     net.initialize(init=mx.initializer.Xavier())
@@ -44,6 +48,9 @@ def test_resnet34_v2():
     _smoke("resnet34_v2", input_size=64)
 
 
+# zoo construction stays tier-1 via save-load roundtrip and the bench
+# smoke's resnet scenario (trains a zoo resnet every tier-1 round)
+@pytest.mark.slow
 def test_resnet50_v1_shape():
     net = vision.get_model("resnet50_v1", classes=7)
     net.initialize()

@@ -26,6 +26,9 @@ def _toy_data(n=240, dim=10, classes=3, seed=7):
     return X, Y
 
 
+# fit-loop mechanics stay tier-1 via forward_backward_update /
+# predict / checkpoint; the convergence soak rides -m slow
+@pytest.mark.slow
 def test_module_fit_convergence():
     """End-to-end Module.fit (the reference's train/test_mlp.py pattern)."""
     X, Y = _toy_data()

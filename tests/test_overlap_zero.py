@@ -249,7 +249,10 @@ def test_kvstore_compression_single_device_rides_bucketed_path():
 # elastic ZeRO checkpoints
 # ---------------------------------------------------------------------------
 
-@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("stage", [
+    pytest.param(2, marks=pytest.mark.slow),  # stage-3 twin covers the
+    3,  # same restore path plus param sharding
+])
 def test_zero_checkpoint_elastic_restore(tmp_path, stage):
     """A dp=4 ZeRO-sharded save (flat-padded shards, clipped to the
     LOGICAL length) restores bit-exactly onto a dp=2 step — the pad is
@@ -275,7 +278,10 @@ def test_zero_checkpoint_elastic_restore(tmp_path, stage):
     assert abs(la - lb) < 1e-5, (la, lb)
 
 
-@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("stage", [
+    pytest.param(2, marks=pytest.mark.slow),  # stage-3 twin covers the
+    3,  # same shrink-to-one path plus param sharding
+])
 def test_zero_checkpoint_restores_onto_single_device(tmp_path, stage):
     """Elastic shrink all the way down: a dp=4 flat-sharded ZeRO save
     restores bit-exactly onto a mesh-less single-device (jit-mode)

@@ -277,6 +277,9 @@ def test_measure_moe_overlap_probe():
     assert rep["exposed"]["serial"] >= 0.0
 
 
+# functional parity (moe_matches_dense_expert_eval) stays tier-1;
+# this gluon-wrapper twin of the same dense-equivalence ride -m slow
+@pytest.mark.slow
 def test_gluon_moe_dense_layer():
     """MoE through the Gluon surface: eager + hybridized + trained."""
     from mxnet_tpu import autograd, gluon

@@ -131,6 +131,9 @@ def test_factorization_machine_convergence():
     assert acc > 0.85, f"FM failed to converge: {acc}"
 
 
+# sparse-training mechanics stay tier-1 via the embedding-grad /
+# kvstore test; both FM soaks (convergence + e2e) ride -m slow
+@pytest.mark.slow
 def test_factorization_machine_end_to_end():
     """FM on synthetic CTR (BASELINE config #4): dot(csr, dense) forward,
     sparse-aware grads, convergence; the multi-process kvstore variant

@@ -1,0 +1,719 @@
+"""Streaming data plane (gluon/data/stream.py): indexed shards,
+deterministic global order, decode pool, and the resize-proof cursor.
+
+The load-bearing guarantees pinned here:
+- all three RecordIO index paths (sidecar / native scan / Python scan)
+  agree, and webdataset tar shards group members into samples;
+- the (seed, epoch)-derived global order covers every record exactly
+  once per epoch and is identical across processes;
+- the cursor is a plain dict that round-trips through JSON bit-exactly
+  and a restored reader continues the EXACT uninterrupted sequence;
+- a 4→2→4 chaos resize (and a kill-and-resume in a fresh process)
+  yields zero skipped and zero replayed samples;
+- decode-pool backpressure is bounded and errors propagate to next().
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu.observability as obs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.data import stream as st
+from mxnet_tpu.gluon.data.prefetcher import DevicePrefetcher
+from mxnet_tpu.gluon.data.stream import (
+    GlobalOrder,
+    ShardIndex,
+    ShardSet,
+    StreamReader,
+    write_recordio_shards,
+)
+from mxnet_tpu.resilience import resume
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_shards(tmp_path, n=64, dim=4, shard_size=16, prefix="shard"):
+    samples = [(np.full(dim, i, np.float32), float(i)) for i in range(n)]
+    return st.write_recordio_shards(str(tmp_path), samples,
+                                    shard_size=shard_size, prefix=prefix)
+
+
+def drain_labels(reader):
+    """Consume a reader to exhaustion -> flat list of int labels."""
+    out = [int(x) for _, lab in reader for x in lab]
+    reader.close()
+    return out
+
+
+def reader(paths, **kw):
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("seed", 3)
+    kw.setdefault("window", 8)
+    kw.setdefault("epochs", 2)
+    return StreamReader(paths, **kw)
+
+
+# ---------------------------------------------------------------------------
+# shard index
+# ---------------------------------------------------------------------------
+
+def test_index_paths_agree(tmp_path):
+    """Sidecar .idx, native C scan, and pure-Python scan produce the
+    identical offset table."""
+    paths = make_shards(tmp_path)
+    sidecar = ShardIndex.recordio(paths[0])._index  # .idx exists
+    py = st._python_scan_recordio(paths[0])
+    assert np.array_equal(sidecar, py)
+    native = st._native_scan_recordio(paths[0])
+    if native is not None:  # toolchain-less env: python path already pinned
+        assert np.array_equal(native, py)
+
+
+def test_python_scan_rejects_corrupt_magic(tmp_path):
+    paths = make_shards(tmp_path, n=4, shard_size=4)
+    with open(paths[0], "r+b") as f:
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(MXNetError, match="magic"):
+        st._python_scan_recordio(paths[0])
+
+
+def test_native_and_python_reads_agree(tmp_path, monkeypatch):
+    """ShardIndex.read via MXTPURecordIOReadAt == the Python
+    seek+read fallback, record for record."""
+    paths = make_shards(tmp_path, n=8, shard_size=8)
+    si = ShardIndex.recordio(paths[0])
+    native = [si.read(i) for i in range(len(si))]
+    si.close()
+    monkeypatch.setattr(st, "get_lib", lambda: None)
+    si2 = ShardIndex.recordio(paths[0])
+    python = [si2.read(i) for i in range(len(si2))]
+    si2.close()
+    assert native == python
+    from mxnet_tpu.recordio import unpack
+
+    for i, payload in enumerate(python):
+        header, body = unpack(payload)
+        assert header.label == float(i)
+        assert np.frombuffer(body, np.float32)[0] == float(i)
+
+
+def test_webdataset_tar_index_and_read(tmp_path):
+    """Tar members sharing a basename stem group into one sample dict;
+    reads return each member's exact bytes."""
+    p = tmp_path / "shard-0.tar"
+    with tarfile.open(p, "w") as tf:
+        for i in range(5):
+            for ext, blob in [("cls", str(i).encode()),
+                              ("data.bin", bytes([i]) * 32)]:
+                info = tarfile.TarInfo(f"sample{i:04d}.{ext}")
+                info.size = len(blob)
+                import io as _io
+
+                tf.addfile(info, _io.BytesIO(blob))
+    si = ShardIndex.webdataset(str(p))
+    assert len(si) == 5
+    for i in range(5):
+        sample = si.read(i)
+        assert sample == {"cls": str(i).encode(),
+                          "data.bin": bytes([i]) * 32}
+    si.close()
+
+
+def test_compressed_tar_shard_rejected(tmp_path):
+    p = tmp_path / "shard.tar.gz"
+    p.write_bytes(b"x")
+    with pytest.raises(MXNetError, match="compressed"):
+        st._open_shard(str(p))
+
+
+def test_webdataset_stream_end_to_end(tmp_path):
+    """A StreamReader over tar shards with a custom decode delivers
+    every sample exactly once."""
+    p = tmp_path / "wds.tar"
+    with tarfile.open(p, "w") as tf:
+        import io as _io
+
+        for i in range(24):
+            blob = json.dumps({"i": i}).encode()
+            info = tarfile.TarInfo(f"s{i:05d}.json")
+            info.size = len(blob)
+            tf.addfile(info, _io.BytesIO(blob))
+    rd = StreamReader([str(p)], batch_size=4, seed=0, epochs=1,
+                      decode=lambda s: np.int64(
+                          json.loads(s["json"])["i"]),
+                      collate=lambda xs: np.asarray(xs))
+    seen = [int(x) for b in rd for x in b]
+    rd.close()
+    assert sorted(seen) == list(range(24))
+
+
+# ---------------------------------------------------------------------------
+# deterministic global order
+# ---------------------------------------------------------------------------
+
+def test_global_order_is_a_permutation_each_epoch(tmp_path):
+    paths = make_shards(tmp_path, n=50, shard_size=16)
+    order = GlobalOrder(ShardSet(paths), seed=11, window=8)
+    for epoch in (0, 1):
+        locs = [order.locate(epoch, i) for i in range(50)]
+        assert len(set(locs)) == 50  # every record exactly once
+    e0 = [order.locate(0, i) for i in range(50)]
+    e1 = [order.locate(1, i) for i in range(50)]
+    assert e0 != e1  # reshuffled across epochs
+
+
+def test_global_order_cross_instance_deterministic(tmp_path):
+    """Two independent instances (as two processes would build) agree
+    on every position — string-seeded RNG, not PYTHONHASHSEED."""
+    paths = make_shards(tmp_path, n=50, shard_size=16)
+    a = GlobalOrder(ShardSet(paths), seed=11, window=8)
+    b = GlobalOrder(ShardSet(paths), seed=11, window=8)
+    assert [a.locate(2, i) for i in range(50)] == \
+        [b.locate(2, i) for i in range(50)]
+
+
+def test_window_zero_preserves_within_shard_order(tmp_path):
+    paths = make_shards(tmp_path, n=32, shard_size=16)
+    order = GlobalOrder(ShardSet(paths), seed=5, window=0)
+    locs = [order.locate(0, i) for i in range(32)]
+    # records of each shard appear in ascending record order
+    per_shard = {}
+    for s, r in locs:
+        per_shard.setdefault(s, []).append(r)
+    for recs in per_shard.values():
+        assert recs == sorted(recs)
+
+
+def test_window_shuffle_stays_within_window(tmp_path):
+    paths = make_shards(tmp_path, n=64, shard_size=64)  # one shard
+    order = GlobalOrder(ShardSet(paths), seed=5, window=16,
+                        shuffle_shards=False)
+    locs = [order.locate(0, i)[1] for i in range(64)]
+    for w in range(4):
+        block = locs[w * 16:(w + 1) * 16]
+        assert sorted(block) == list(range(w * 16, (w + 1) * 16))
+        assert block != sorted(block)  # actually shuffled
+
+
+# ---------------------------------------------------------------------------
+# reader: order, determinism, epochs
+# ---------------------------------------------------------------------------
+
+def test_stream_reader_content_and_determinism(tmp_path):
+    paths = make_shards(tmp_path)
+    rd = reader(paths)
+    seen = []
+    for data, label in rd:
+        assert np.array_equal(data[:, 0], label)  # decode correctness
+        seen.extend(int(x) for x in label)
+    rd.close()
+    # 64 records, bs=8 -> 8 whole batches per epoch, 2 epochs
+    assert len(seen) == 128
+    assert sorted(seen[:64]) == list(range(64))  # epoch 0 complete
+    assert drain_labels(reader(paths)) == seen  # replayable
+
+
+def test_stream_reader_drop_tail_whole_batches(tmp_path):
+    paths = make_shards(tmp_path, n=50, shard_size=16)
+    seen = drain_labels(StreamReader(paths, batch_size=8, seed=1,
+                                     epochs=1))
+    assert len(seen) == 48  # 50 -> 6 whole batches, tail dropped
+    assert len(set(seen)) == 48  # no dup inside the epoch
+
+
+def test_stream_reader_infinite_reshuffles(tmp_path):
+    paths = make_shards(tmp_path, n=16, shard_size=8)
+    rd = StreamReader(paths, batch_size=16, seed=2, window=4,
+                      epochs=None)
+    it = iter(rd)
+    e0 = [int(x) for x in next(it)[1]]
+    e1 = [int(x) for x in next(it)[1]]
+    e2 = [int(x) for x in next(it)[1]]  # infinite: keeps going
+    rd.close()
+    assert sorted(e0) == sorted(e1) == sorted(e2) == list(range(16))
+    assert not (e0 == e1 == e2)  # epochs reshuffle
+
+
+# ---------------------------------------------------------------------------
+# cursor: checkpoint round-trip, resume, repartition
+# ---------------------------------------------------------------------------
+
+def test_cursor_json_roundtrip_bit_exact(tmp_path):
+    paths = make_shards(tmp_path)
+    rd = reader(paths)
+    it = iter(rd)
+    for _ in range(3):
+        next(it)
+    state = rd.state()
+    rd.close()
+    wire = json.loads(json.dumps(state))
+    assert wire == state  # bit-exact through serialization
+    rd2 = reader(paths).restore(wire)
+    assert rd2.state() == state
+    rd2.close()
+
+
+def test_kill_and_resume_exact_sequence(tmp_path):
+    """Consume 5 batches, 'die', restore from the JSON cursor in a new
+    reader: the concatenation IS the uninterrupted sequence — no
+    sample skipped, none replayed."""
+    paths = make_shards(tmp_path)
+    full = drain_labels(reader(paths))
+    rd = reader(paths)
+    it = iter(rd)
+    head = [int(x) for _ in range(5) for x in next(it)[1]]
+    wire = json.dumps(rd.state())
+    rd.close()  # staged read-ahead discarded — cursor marks delivered
+    tail = drain_labels(reader(paths).restore(json.loads(wire)))
+    assert head + tail == full
+
+
+def test_restore_rejects_diverging_configuration(tmp_path):
+    paths = make_shards(tmp_path)
+    state = reader(paths).state()
+    with pytest.raises(MXNetError, match="diverge"):
+        reader(paths, batch_size=4).restore(state)
+    with pytest.raises(MXNetError, match="diverge"):
+        reader(paths, seed=99).restore(state)
+    short = make_shards(tmp_path / "other", n=32, prefix="o")
+    with pytest.raises(MXNetError, match="records"):
+        reader(short).restore(state)
+    with pytest.raises(MXNetError, match="not a stream"):
+        reader(paths).restore(7)
+
+
+def interleave(parts):
+    """Round-robin step-major merge of per-rank batch lists — the
+    global consumption order of a data-parallel group."""
+    out = []
+    for i in range(max(len(p) for p in parts)):
+        for p in parts:
+            if i < len(p):
+                out.extend(p[i])
+    return out
+
+
+def rank_batches(paths, state, world, rank, steps=None, limit=None,
+                 **kw):
+    """Restore `state`, repartition to (world, rank), consume up to
+    `limit` batches -> list of per-batch label lists + final state."""
+    rd = reader(paths, **kw).restore(state)
+    rd.repartition(world=world, rank=rank, steps=steps)
+    out = []
+    it = iter(rd)
+    while limit is None or len(out) < limit:
+        try:
+            out.append([int(x) for x in next(it)[1]])
+        except StopIteration:
+            break
+    state = rd.state()
+    rd.close()
+    return out, state
+
+
+def test_chaos_resize_4_2_4_zero_skip_zero_replay(tmp_path):
+    """The acceptance pin: a 4->2->4 elastic resize mid-stream yields
+    the EXACT uninterrupted global sample sequence — zero skipped,
+    zero replayed — with every leg's cursor travelling as JSON."""
+    paths = make_shards(tmp_path, n=256, shard_size=32)
+    full = drain_labels(StreamReader(paths, batch_size=4, seed=9,
+                                     window=16, epochs=1))
+    kw = dict(batch_size=4, seed=9, window=16, epochs=1)
+    base = StreamReader(paths, **kw).state()
+
+    # leg 1: world=4, 3 steps each
+    legs, states = [], []
+    for r in range(4):
+        out, s = rank_batches(paths, json.loads(json.dumps(base)),
+                              4, r, limit=3, **kw)
+        legs.append(out)
+        states.append(s)
+    leg1 = interleave(legs)
+    assert all(s["steps"] == 3 for s in states)
+
+    # shrink 4 -> 2 (two survivors re-partition from the step boundary)
+    legs2, states2 = [], []
+    for r in range(2):
+        out, s = rank_batches(paths, json.loads(json.dumps(states[r])),
+                              2, r, limit=4, **kw)
+        legs2.append(out)
+        states2.append(s)
+    leg2 = interleave(legs2)
+
+    # grow 2 -> 4 (two ranks rejoin) and drain to the end
+    legs3 = []
+    for r in range(4):
+        out, _ = rank_batches(paths,
+                              json.loads(json.dumps(states2[r % 2])),
+                              4, r, **kw)
+        legs3.append(out)
+    leg3 = interleave(legs3)
+
+    got = leg1 + leg2 + leg3
+    assert got == full  # exact order: no skip, no replay, no reorder
+    assert sorted(got) == sorted(full)
+
+
+def test_repartition_requires_step_boundary_consistency(tmp_path):
+    paths = make_shards(tmp_path)
+    rd = reader(paths)
+    with pytest.raises(MXNetError, match="rank"):
+        rd.repartition(world=2, rank=2)
+    rd.close()
+
+
+def test_reset_rewinds_to_stream_start(tmp_path):
+    paths = make_shards(tmp_path)
+    rd = reader(paths)
+    it = iter(rd)
+    first = [int(x) for x in next(it)[1]]
+    for _ in range(2):
+        next(it)
+    rd.reset()
+    assert rd.state()["steps"] == 0 and rd.state()["base_batch"] == 0
+    assert [int(x) for x in next(iter(rd))[1]] == first
+    rd.close()
+
+
+# ---------------------------------------------------------------------------
+# decode pool: backpressure, errors, wait accounting
+# ---------------------------------------------------------------------------
+
+def test_decode_error_propagates_to_consumer(tmp_path):
+    paths = make_shards(tmp_path, n=32, shard_size=32)
+
+    def bomb(payload):
+        sample = st.decode_recordio_f32(payload)
+        if int(sample[1]) == 13:
+            raise ValueError("record 13 is cursed")
+        return sample
+
+    rd = StreamReader(paths, batch_size=4, seed=0, epochs=1,
+                      window=0, shuffle_shards=False, decode=bomb)
+    with pytest.raises(ValueError, match="cursed"):
+        for _ in rd:
+            pass
+    with pytest.raises(ValueError, match="cursed"):  # error is sticky
+        next(rd)
+    rd.close()
+
+
+def test_backpressure_bounds_readahead(tmp_path):
+    """With readahead=4 a stalled consumer never sees more than the
+    bounded raw + reorder staging — the reader does not inhale the
+    whole dataset."""
+    paths = make_shards(tmp_path, n=64, shard_size=64)
+    rd = StreamReader(paths, batch_size=4, seed=0, epochs=1,
+                      readahead=4, pool=2)
+    it = iter(rd)
+    next(it)  # spin up the pipeline
+    time.sleep(0.3)  # consumer stalls; producers hit the bound
+    with rd._cv:
+        staged = len(rd._reorder)
+    raw = rd._raw_q.qsize()
+    # decode pool may hold one in-flight record per worker beyond the
+    # buffer bound
+    assert staged <= 4 + 2 + rd.batch_size
+    assert raw <= 4
+    rd.close()
+
+
+def test_decode_pool_runs_off_consumer_thread(tmp_path):
+    paths = make_shards(tmp_path, n=32, shard_size=32)
+    tids = set()
+
+    def spy(payload):
+        tids.add(threading.get_ident())
+        return st.decode_recordio_f32(payload)
+
+    rd = StreamReader(paths, batch_size=4, seed=0, epochs=1,
+                      decode=spy, pool=3)
+    drain_labels(rd)
+    assert threading.get_ident() not in tids  # never on the train thread
+    assert len(tids) >= 1
+
+
+def test_stream_telemetry_counters(tmp_path):
+    paths = make_shards(tmp_path, n=32, shard_size=16)
+    obs.reset()
+    obs.set_enabled(True)
+    try:
+        drain_labels(StreamReader(paths, batch_size=4, seed=0,
+                                  epochs=1))
+        assert obs.STREAM_BATCHES_TOTAL.total() == 8
+        assert obs.STREAM_RECORDS_TOTAL.total() == 32
+        assert obs.STREAM_READ_BYTES.total() > 0
+        assert obs.STREAM_DECODE_SECONDS.total() >= 0
+        assert obs.STREAM_CONSUMER_WAIT_SECONDS.total() >= 0
+        names = {r["name"] for r in obs.tracer().events()}
+        assert "stream.batch" in names
+    finally:
+        obs.set_enabled(False)
+        obs.reset()
+
+
+def test_emulated_latency_slows_reads(tmp_path, monkeypatch):
+    paths = make_shards(tmp_path, n=8, shard_size=8)
+    si = ShardIndex.recordio(paths[0])
+    t0 = time.perf_counter()
+    si.read(0)
+    fast = time.perf_counter() - t0
+    monkeypatch.setenv("MXTPU_STREAM_LATENCY_MS", "30")
+    t0 = time.perf_counter()
+    si.read(0)
+    slow = time.perf_counter() - t0
+    si.close()
+    assert slow >= 0.03 > fast
+
+
+def test_env_knob_defaults(monkeypatch):
+    for var in ("MXTPU_STREAM_DECODE_THREADS", "MXTPU_STREAM_READAHEAD",
+                "MXTPU_STREAM_LATENCY_MS", "MXTPU_STREAM_WINDOW"):
+        monkeypatch.delenv(var, raising=False)
+    assert st.decode_threads() == 4
+    assert st.readahead_records() == 128
+    assert st.emulated_latency_ms() == 0.0
+    assert st.shuffle_window() == 0
+    monkeypatch.setenv("MXTPU_STREAM_DECODE_THREADS", "0")
+    assert st.decode_threads() == 1  # clamped
+
+
+# ---------------------------------------------------------------------------
+# prefetcher + checkpoint integration
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_structured_cursor_counts_delivered_only(tmp_path):
+    paths = make_shards(tmp_path)
+    rd = reader(paths, epochs=1)
+    pf = DevicePrefetcher(rd, depth=4)
+    it = iter(pf)
+    for _ in range(3):
+        next(it)
+    cur = pf.cursor
+    assert cur["kind"] == "stream" and cur["steps"] == 3
+    # the SOURCE is ahead (staged batches) — the cursor must not be
+    assert rd.state()["steps"] >= cur["steps"]
+    pf.close()
+
+
+def test_prefetcher_world_repartition_zero_skip(tmp_path):
+    paths = make_shards(tmp_path, n=64, shard_size=16)
+    kw = dict(batch_size=4, seed=7, window=8, epochs=1)
+    full = drain_labels(StreamReader(paths, **kw))
+    pf = DevicePrefetcher(StreamReader(paths, **kw), depth=3)
+    it = iter(pf)
+    head = [int(x) for _ in range(3) for x in next(it)[1].data.ravel()]
+    wire = json.loads(json.dumps(pf.cursor))
+    pf.repartition(world=2, rank=0)
+    mine = [[int(x) for x in b[1].data.ravel()] for b in pf]
+    pf.close()
+    sib = StreamReader(paths, **kw).restore(wire)
+    sib.repartition(world=2, rank=1)
+    theirs = [[int(x) for x in lab] for _, lab in sib]
+    sib.close()
+    assert head + interleave([mine, theirs]) == full
+
+
+def test_prefetcher_world_repartition_needs_stream_source():
+    pf = DevicePrefetcher(iter([]), depth=1)
+    with pytest.raises(ValueError, match="no repartition"):
+        pf.repartition(world=2, rank=0)
+    pf.close()
+
+
+def test_checkpoint_extras_carry_dict_cursor(tmp_path):
+    from mxnet_tpu.resilience import checkpoint as ckpt
+
+    cursor = reader([p for p in make_shards(tmp_path)]).state()
+    path = ckpt.write_checkpoint(
+        str(tmp_path / "ckpt"), {"param::w": np.zeros(2, np.float32)},
+        {"cursor": dict(cursor), "kind": "trainer"}, step=5)
+    manifest, _ = ckpt.read_checkpoint(path)
+    assert manifest["extras"]["cursor"] == cursor  # bit-exact
+
+
+def test_restore_cursor_dispatch(tmp_path):
+    paths = make_shards(tmp_path)
+    full = drain_labels(reader(paths))
+    rd = reader(paths)
+    it = iter(rd)
+    head = [int(x) for _ in range(2) for x in next(it)[1]]
+    cur = rd.state()
+    rd.close()
+    # dict cursor -> native restore
+    it2 = resume.restore_cursor(reader(paths), cur)
+    tail = [int(x) for _, lab in it2 for x in lab]
+    assert head + tail == full
+    # int cursor -> skip_batches fallback
+    it3 = resume.restore_cursor(iter([1, 2, 3]), 2)
+    assert list(it3) == [3]
+    # dict cursor onto a restore-less source -> loud failure
+    with pytest.raises(MXNetError, match="restore"):
+        resume.restore_cursor([1, 2, 3], cur)
+
+
+def test_kill_and_resume_subprocess(tmp_path):
+    """Fresh-process resume: a child consumes 4 batches and prints its
+    cursor; a SECOND process restores from that JSON and drains. The
+    two halves concatenate to the exact single-process sequence."""
+    paths = make_shards(tmp_path, n=64, shard_size=16)
+    full = drain_labels(StreamReader(paths, batch_size=8, seed=3,
+                                     window=8, epochs=1))
+    child = f"""
+import json, sys
+sys.path.insert(0, {ROOT!r})
+from mxnet_tpu.gluon.data.stream import StreamReader
+paths = {paths!r}
+rd = StreamReader(paths, batch_size=8, seed=3, window=8, epochs=1)
+cursor = sys.argv[1] if len(sys.argv) > 1 else None
+if cursor:
+    rd.restore(json.loads(cursor))
+out = []
+it = iter(rd)
+limit = 4 if cursor is None else None
+while limit is None or len(out) < limit * 8:
+    try:
+        out.extend(int(x) for x in next(it)[1])
+    except StopIteration:
+        break
+print("RESULT " + json.dumps({{"seen": out, "cursor": rd.state()}}))
+"""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(*args):
+        res = subprocess.run([sys.executable, "-c", child, *args],
+                             env=env, capture_output=True, text=True,
+                             timeout=120)
+        assert res.returncode == 0, res.stderr
+        line = [ln for ln in res.stdout.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        return json.loads(line[len("RESULT "):])
+
+    first = run()
+    assert first["cursor"]["steps"] == 4
+    second = run(json.dumps(first["cursor"]))
+    assert first["seen"] + second["seen"] == full
+
+
+# ---------------------------------------------------------------------------
+# telemetry report: Input pipeline section
+# ---------------------------------------------------------------------------
+
+def _report_module():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        os.path.join(ROOT, "tools", "telemetry_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_input_pipeline_section_end_to_end(tmp_path):
+    """Stream with telemetry on, dump the trace, run the report CLI:
+    the Input-pipeline section shows per-shard reads, decode-pool
+    utilization, and the consumer-wait join against step spans."""
+    paths = make_shards(tmp_path, n=32, shard_size=16)
+    obs.reset()
+    obs.set_enabled(True)
+    try:
+        with obs.span("trainer.step", cat="trainer"):
+            drain_labels(StreamReader(paths, batch_size=4, seed=0,
+                                      epochs=1))
+        trace = str(tmp_path / "t.jsonl")
+        obs.dump_jsonl(trace)
+    finally:
+        obs.set_enabled(False)
+        obs.reset()
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "tools", "telemetry_report.py"), trace],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    out = res.stdout
+    assert "Input pipeline:" in out
+    assert "batches delivered" in out
+    assert "decode pool:" in out and "utilization" in out
+    assert "input wait / step time:" in out
+    assert "shard-00000.rec" in out  # per-shard read table
+
+
+def test_report_input_pipeline_crash_proof():
+    """Malformed/absent args never crash the section (the report
+    contract: absent series -> empty string, junk args -> '-'/zero)."""
+    tr = _report_module()
+    assert tr.render_input_pipeline([]) == ""
+    assert tr.render_input_pipeline(
+        [{"name": "trainer.step", "dur": 5.0}]) == ""
+    junk = [
+        {"name": "stream.batch"},  # no args at all
+        {"name": "stream.batch", "args": {"consumer_wait": "nan?"}},
+        {"name": "stream.batch", "args": {"consumer_wait": 0.001,
+                                          "reorder_depth": 3}},
+        {"name": "stream.stats", "args": None},
+        {"name": "stream.stats",
+         "args": {"per_shard": {"s": "junk", "t": {"bytes": 1e6,
+                                                   "seconds": 0.5,
+                                                   "records": 10}},
+                  "decode_busy": "x", "depth_reorder": None}},
+    ]
+    out = tr.render_input_pipeline(junk)
+    assert "Input pipeline:" in out
+    assert "3 batches delivered" in out
+    assert "t" in out  # well-formed shard row survives its junk sibling
+
+
+def test_doctor_input_bound_recipe_names_stream_knobs():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mxtpu_doctor", os.path.join(ROOT, "tools", "mxtpu_doctor.py"))
+    doctor = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(doctor)
+    _meaning, recipe = doctor.RECIPES["input_bound"]
+    assert "MXTPU_STREAM_DECODE_THREADS" in recipe
+    assert "MXTPU_STREAM_READAHEAD" in recipe
+    assert "shard" in recipe  # shard-parallelism guidance
+
+
+# ---------------------------------------------------------------------------
+# on-device augmentation
+# ---------------------------------------------------------------------------
+
+def test_device_augment_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    aug = st.device_augment(crop=(4, 4), flip=True,
+                            mean=(1.0, 2.0, 3.0), std=(2.0, 2.0, 2.0))
+    images = jnp.arange(2 * 8 * 8 * 3, dtype=jnp.float32).reshape(
+        2, 8, 8, 3)
+    key = jax.random.PRNGKey(0)
+    out = jax.jit(aug)(images, key)
+    assert out.shape == (2, 4, 4, 3)  # static crop under jit
+    # deterministic in the key; different keys differ
+    again = jax.jit(aug)(images, key)
+    assert jnp.array_equal(out, again)
+    other = jax.jit(aug)(images, jax.random.PRNGKey(1))
+    assert not jnp.array_equal(out, other)
+
+
+def test_device_augment_normalize_only_matches_numpy():
+    import jax
+    import jax.numpy as jnp
+
+    aug = st.device_augment(mean=(0.5,), std=(0.25,))
+    x = jnp.linspace(0, 1, 2 * 3 * 3 * 1).reshape(2, 3, 3, 1)
+    out = aug(x, jax.random.PRNGKey(0))
+    np.testing.assert_allclose(
+        np.asarray(out), (np.asarray(x) - 0.5) / 0.25, rtol=1e-6)

@@ -55,12 +55,19 @@ _HIGHER_BETTER = re.compile(
     r"|recovered|hidden|fraction|_mfu|mfu_|fill|ranks|ok$|_ok_)", re.I)
 _LOWER_BETTER = re.compile(
     r"(_ms|_s$|_us|seconds|latency|overhead|_time|time_|p50|p99|p999"
-    r"|lost|miss|stale|errors|skew|wait|age|exposed|dispatch)", re.I)
+    r"|lost|miss|stale|errors|skew|wait|age|exposed|dispatch"
+    r"|skip|replay)", re.I)
 
 #: checked before the generic token maps: ``bubble_fraction`` and MoE
 #: ``drop(ped)_fraction`` are lower-is-better even though the bare
-#: ``fraction`` segment (comm_hidden_fraction etc.) reads higher-better
-_LOWER_FIRST = re.compile(r"(bubble|drop(ped)?_fraction)", re.I)
+#: ``fraction`` segment (comm_hidden_fraction etc.) reads higher-better.
+#: The streaming-input wait family (``consumer_wait*``/``decode_wait*``/
+#: ``input_wait*``) pins here too: a ``consumer_wait_fraction`` row
+#: would otherwise read higher-better via the ``fraction`` token — the
+#: exact inversion shape the PR-15/PR-19 ordering bugs came from
+_LOWER_FIRST = re.compile(
+    r"(bubble|drop(ped)?_fraction|consumer_wait|decode_wait"
+    r"|input_wait)", re.I)
 
 #: unit-based direction for emit rows (takes precedence over names)
 _UNIT_HIGHER = re.compile(r"/s$|/sec$", re.I)

@@ -45,9 +45,13 @@ PHASES = ("input_wait", "h2d", "ckpt_overhead", "comm_exposed",
 RECIPES = {
     "input_bound": (
         "the accelerator idles waiting on the host input pipeline",
-        "raise MXTPU_DEVICE_PREFETCH (staging queue depth), add "
-        "DataLoader num_workers, or move decode off the consumer "
-        "thread (docs/performance.md)"),
+        "feed through gluon.data.StreamReader and widen its decode "
+        "pool (MXTPU_STREAM_DECODE_THREADS) if decode-bound, raise "
+        "its prefetch depth (MXTPU_STREAM_READAHEAD) and shard "
+        "parallelism (more/smaller shards) if storage-bound — "
+        "mxtpu_stream_decode_wait_seconds_total tells which; then "
+        "raise MXTPU_DEVICE_PREFETCH (staging queue depth) "
+        "(docs/performance.md 'Streaming input')"),
     "comm_bound": (
         "gradient communication is exposed, not hidden behind compute",
         "use the bucket-ready overlapped comm mode (MXTPU_OVERLAP=ready) "

@@ -48,6 +48,13 @@ HOT_FUNCTIONS = [
     ("mxnet_tpu/gluon/data/prefetcher.py", "DevicePrefetcher.__next__"),
     ("mxnet_tpu/gluon/data/prefetcher.py", "SuperstepRing.__next__"),
     ("mxnet_tpu/gluon/data/prefetcher.py", "_stack_leaves"),
+    # streaming reader: the read-ahead thread, the decode pool, and
+    # the in-order consumer — a host sync in any of these stalls the
+    # pipeline that exists to hide host work
+    ("mxnet_tpu/gluon/data/stream.py", "StreamReader._read_loop"),
+    ("mxnet_tpu/gluon/data/stream.py", "StreamReader._decode_loop"),
+    ("mxnet_tpu/gluon/data/stream.py", "StreamReader.__next__"),
+    ("mxnet_tpu/gluon/data/stream.py", "ShardIndex.read"),
     # SPMD mesh-side step
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.step"),
     ("mxnet_tpu/parallel/spmd.py", "SPMDTrainStep.run_superstep"),

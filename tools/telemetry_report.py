@@ -390,6 +390,84 @@ def render_roofline(events):
     return "\n".join(lines)
 
 
+def render_input_pipeline(events):
+    """'Input pipeline' section from the streaming-reader series:
+    ``stream.batch`` spans (one per delivered batch, dur = the train
+    thread's consumer wait) joined against ``trainer.step`` /
+    ``trainer.superstep`` spans for the input-bound fraction, plus the
+    cumulative ``stream.stats`` instants (per-shard read totals,
+    decode-pool busy/wait, staging depths). Same crash-proofing
+    contract as the AMP/serving sections: absent series -> empty
+    string, malformed args render as '-' / count as zero."""
+    batches = [ev for ev in events if ev.get("name") == "stream.batch"]
+    stats = [ev for ev in events if ev.get("name") == "stream.stats"]
+    if not (batches or stats):
+        return ""
+
+    def num(args, key):
+        v = args.get(key) if isinstance(args, dict) else None
+        return float(v) if isinstance(v, (int, float)) else None
+
+    lines = ["", "Input pipeline:"]
+    waits = [w for w in (num(ev.get("args"), "consumer_wait")
+                         for ev in batches) if w is not None]
+    depths = [d for d in (num(ev.get("args"), "reorder_depth")
+                          for ev in batches) if d is not None]
+    if batches:
+        total_wait = sum(waits)
+        mean_ms = total_wait / len(waits) * 1e3 if waits else 0.0
+        peak_ms = max(waits) * 1e3 if waits else 0.0
+        depth = (f"{sum(depths) / len(depths):.1f} avg / "
+                 f"{max(depths):.0f} peak" if depths else "-")
+        lines.append(
+            f"  {len(batches)} batches delivered, consumer wait "
+            f"{total_wait * 1e3:.1f} ms total "
+            f"({mean_ms:.3f} ms/batch avg, {peak_ms:.3f} ms peak), "
+            f"reorder depth {depth}")
+        # join against the step spans: what fraction of train wall
+        # time the device spent waiting on input
+        step_us = sum(float(ev.get("dur", 0.0)) for ev in events
+                      if ev.get("name") in ("trainer.step",
+                                            "trainer.superstep"))
+        if step_us > 0 and waits:
+            frac = min(1.0, total_wait * 1e6 / step_us)
+            verdict = "input-bound" if frac >= 0.15 else "saturated"
+            lines.append(
+                f"  input wait / step time: {frac:.1%} ({verdict} — "
+                f"see mxtpu-doctor input_bound for knobs)")
+    if stats:
+        args = stats[-1].get("args")
+        args = args if isinstance(args, dict) else {}
+        busy = num(args, "decode_busy") or 0.0
+        idle = num(args, "decode_wait") or 0.0
+        if busy + idle > 0:
+            lines.append(
+                f"  decode pool: {busy:.2f} s busy / {idle:.2f} s "
+                f"waiting on storage "
+                f"(utilization {busy / (busy + idle):.1%})")
+        raw = num(args, "depth_raw")
+        lines.append(
+            f"  staging depth: raw "
+            f"{'-' if raw is None else f'{raw:.0f}'} / reorder "
+            f"{'-' if num(args, 'depth_reorder') is None else int(args['depth_reorder'])}")
+        shards = args.get("per_shard")
+        if isinstance(shards, dict) and shards:
+            lines.append(f"  {'Shard':<24}{'Records':>10}{'MB':>10}"
+                         f"{'MB/s':>10}")
+            for name in sorted(shards):
+                rec = shards[name] if isinstance(shards[name], dict) \
+                    else {}
+                nbytes = num(rec, "bytes") or 0.0
+                secs = num(rec, "seconds") or 0.0
+                rate = f"{nbytes / secs / 1e6:10.1f}" if secs > 0 \
+                    else f"{'-':>10}"
+                lines.append(
+                    f"  {str(name)[:23]:<24}"
+                    f"{int(num(rec, 'records') or 0):>10}"
+                    f"{nbytes / 1e6:>10.2f}{rate}")
+    return "\n".join(lines)
+
+
 def render_steps(events):
     """Per-step timeline of trainer.step spans, when present."""
     steps = [ev for ev in events if ev.get("name") == "trainer.step"]
@@ -571,6 +649,9 @@ def main(argv=None):
     serving = render_serving(events)
     if serving:
         print(serving)
+    ipipe = render_input_pipeline(events)
+    if ipipe:
+        print(ipipe)
     fleet = render_fleet(events)
     if fleet:
         print(fleet)
